@@ -1,0 +1,22 @@
+"""Numerical ops: L0 primitives, PSWF windows, and the SwiftlyCore."""
+
+from .core import SwiftlyCore, validate_core_params
+from .oracle import (
+    generate_masks,
+    make_facet_from_sources,
+    make_subgrid_from_sources,
+    mask_from_slices,
+)
+from .pswf import pswf_fb, pswf_fn, pswf_samples
+
+__all__ = [
+    "SwiftlyCore",
+    "validate_core_params",
+    "generate_masks",
+    "make_facet_from_sources",
+    "make_subgrid_from_sources",
+    "mask_from_slices",
+    "pswf_fb",
+    "pswf_fn",
+    "pswf_samples",
+]

@@ -1,0 +1,138 @@
+"""L0 array primitives — JAX/TPU implementations.
+
+These are the building blocks of the streaming distributed Fourier transform
+(facet <-> subgrid). Functional parity with the reference numpy layer
+(/root/reference/src/ska_sdp_exec_swiftly/fourier_transform/fourier_algorithm.py),
+re-designed for XLA:
+
+* All *sizes* are static (compile-time); all *offsets* are dynamic (traced),
+  so a single compiled program serves every facet/subgrid offset of a config.
+* Centre-pad + roll and roll + centre-extract chains are fused into single
+  wrapped gather/scatter helpers (`wrapped_extract` / `wrapped_embed`) so XLA
+  moves only the small window instead of rolling full-size arrays.
+
+Centre conventions (must match reference `fourier_algorithm.py:64-93` exactly):
+  - the centre index of a length-n axis is n//2
+  - extract_mid keeps indices [c - n//2, c - n//2 + n) of the source
+  - pad_mid places the source at [n//2 - n0//2, n//2 - n0//2 + n0) of the target
+Both formulas are parity-correct for even and odd n.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "broadcast_along",
+    "coordinates",
+    "extract_mid",
+    "fft",
+    "ifft",
+    "pad_mid",
+    "roll_axis",
+    "wrapped_extract",
+    "wrapped_embed",
+]
+
+
+def coordinates(n: int) -> np.ndarray:
+    """1D coordinate array spanning [-0.5, 0.5) with 0 at index n//2.
+
+    Host-side (numpy): used for PSWF precomputation and tests only.
+    Parity: reference ``fourier_algorithm.py:125-138``.
+    """
+    half = n // 2
+    return (np.arange(n) - half) / n
+
+
+def broadcast_along(vec, ndim: int, axis: int):
+    """Reshape a 1D vector so it broadcasts along `axis` of an `ndim` array.
+
+    Parity: reference ``broadcast`` (``fourier_algorithm.py:38-50``).
+    """
+    shape = [1] * ndim
+    shape[axis] = -1
+    return jnp.reshape(vec, shape)
+
+
+def pad_mid(a, n: int, axis: int):
+    """Zero-pad `a` to size `n` along `axis`, keeping the centre aligned.
+
+    Static-size operation. Parity: reference ``pad_mid``
+    (``fourier_algorithm.py:53-73``).
+    """
+    n0 = a.shape[axis]
+    if n == n0:
+        return a
+    before = n // 2 - n0 // 2
+    pads = [(0, 0)] * a.ndim
+    pads[axis] = (before, n - n0 - before)
+    return jnp.pad(a, pads)
+
+
+def extract_mid(a, n: int, axis: int):
+    """Extract the centred length-`n` window along `axis` (inverse of pad_mid).
+
+    Static-size operation. Parity: reference ``extract_mid``
+    (``fourier_algorithm.py:76-93``).
+    """
+    n0 = a.shape[axis]
+    if n == n0:
+        return a
+    start = n0 // 2 - n // 2
+    return jax.lax.slice_in_dim(a, start, start + n, axis=axis)
+
+
+def fft(a, axis: int):
+    """Centred-zero FFT (image -> grid space) along one axis.
+
+    fftshift(fft(ifftshift(x))). Parity: reference ``fft``
+    (``fourier_algorithm.py:96-107``).
+    """
+    return jnp.fft.fftshift(
+        jnp.fft.fft(jnp.fft.ifftshift(a, axes=axis), axis=axis), axes=axis
+    )
+
+
+def ifft(a, axis: int):
+    """Centred-zero inverse FFT (grid -> image space) along one axis.
+
+    Parity: reference ``ifft`` (``fourier_algorithm.py:110-122``).
+    """
+    return jnp.fft.fftshift(
+        jnp.fft.ifft(jnp.fft.ifftshift(a, axes=axis), axis=axis), axes=axis
+    )
+
+
+def roll_axis(a, shift, axis: int):
+    """jnp.roll along one axis with a (possibly traced) shift."""
+    return jnp.roll(a, shift, axis=axis)
+
+
+def wrapped_extract(a, n: int, shift, axis: int):
+    """Gather the length-`n` centre window of `a` after a circular shift.
+
+    Equivalent to ``extract_mid(roll(a, -shift, axis), n, axis)`` but gathers
+    only `n` elements instead of rolling the full array. `shift` may be a
+    traced scalar; `n` is static.
+    """
+    size = a.shape[axis]
+    idx = (size // 2 - n // 2 + jnp.arange(n) + shift) % size
+    return jnp.take(a, idx, axis=axis)
+
+
+def wrapped_embed(a, n: int, shift, axis: int):
+    """Scatter `a` into the centre of a length-`n` zero array, then shift.
+
+    Equivalent to ``roll(pad_mid(a, n, axis), shift, axis)`` with wraparound,
+    but scatters only ``a.shape[axis]`` elements. `shift` may be traced;
+    `n` is static. Adjoint of :func:`wrapped_extract`.
+    """
+    m = a.shape[axis]
+    idx = (n // 2 - m // 2 + jnp.arange(m) + shift) % n
+    moved = jnp.moveaxis(a, axis, 0)
+    out_shape = (n,) + moved.shape[1:]
+    out = jnp.zeros(out_shape, dtype=a.dtype).at[idx].set(moved)
+    return jnp.moveaxis(out, 0, axis)

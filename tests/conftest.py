@@ -1,0 +1,27 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices (multi-chip sharding is
+validated without TPU hardware, mirroring how the reference tests multi-node
+with an in-process Dask cluster) and with x64 enabled (the accuracy targets
+— round-trip RMS < 3e-10 — require float64).
+
+Must run before jax initialises its backend, hence the env vars at import
+time of this conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize imports jax at interpreter startup (before
+# this conftest), so JAX_PLATFORMS from os.environ was already consumed —
+# override via config (backends initialise lazily, so this is still in time).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,302 @@
+"""Tier-2 tests: SwiftlyCore primitives vs the analytic DFT oracle.
+
+Mirrors the reference's test_core.py coverage — parameter validation,
+constant-value subgrids, 1D/2D facet->subgrid against direct DFT
+(decimal=8), 1D/2D subgrid->facet (decimal=11), even and odd data sizes,
+off-grid offsets — parameterised over both backends so numpy and JAX stay
+behaviourally identical.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.ops import (
+    SwiftlyCore,
+    make_facet_from_sources,
+    make_subgrid_from_sources,
+)
+
+PARAMS = {
+    "W": 13.5625,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+BACKENDS = ["numpy", "jax"]
+
+
+def make_core(backend, pars=PARAMS):
+    return SwiftlyCore(
+        pars["W"], pars["N"], pars["xM_size"], pars["yN_size"], backend=backend
+    )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_core_attributes(backend):
+    core = make_core(backend)
+    assert core.W == PARAMS["W"]
+    assert core.N == PARAMS["N"]
+    assert core.xM_size == PARAMS["xM_size"]
+    assert core.yN_size == PARAMS["yN_size"]
+    assert core.xM_yN_size == 128
+    assert core.subgrid_off_step == 2
+    assert core.facet_off_step == 4
+    assert "SwiftlyCore" in repr(core)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize(
+    "bad",
+    [
+        {"N": 1050},  # N not divisible by yN
+        {"xM_size": 200},  # N not divisible by xM
+        {"yN_size": 128, "xM_size": 4},  # contribution size not integer
+    ],
+)
+def test_core_param_validation(backend, bad):
+    pars = dict(PARAMS, **bad)
+    with pytest.raises(ValueError):
+        make_core(backend, pars)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("xA_size", [228, 227])
+@pytest.mark.parametrize("yB_size", [416, 415])
+def test_facet_to_subgrid_constant(backend, xA_size, yB_size):
+    """A centred delta at intensity v must produce constant subgrids v/N."""
+    core = make_core(backend)
+    N = PARAMS["N"]
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    for val, facet_off in itertools.product(
+        [1, 0.1], [-5 * Ny, -Ny, 0, 2 * Ny]
+    ):
+        facet = np.zeros(yB_size)
+        facet[yB_size // 2 - facet_off] = val
+        prepped = core.prepare_facet(facet, facet_off, axis=0)
+        for sg_off in [0, Nx, 5 * Nx, 9 * Nx]:
+            contrib = core.extract_from_facet(prepped, sg_off, axis=0)
+            acc = core.add_to_subgrid(contrib, facet_off, axis=0)
+            subgrid = np.asarray(core.finish_subgrid(acc, sg_off, xA_size))
+            np.testing.assert_array_almost_equal(subgrid, val / N, decimal=15)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("xA_size", [228, 227])
+@pytest.mark.parametrize("yB_size", [416, 415])
+def test_facet_to_subgrid_vs_dft_1d(backend, xA_size, yB_size):
+    core = make_core(backend)
+    N = PARAMS["N"]
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    source_lists = [
+        [(1, 0)],
+        [(2, 1)],
+        [(1, -3)],
+        [(-0.1, 5)],
+        [(1 / 8, 20), (2 / 8, 5), (3 / 8, -4)],
+        [(1, yB_size)],  # border (clamped below)
+        [(1 / 16, i) for i in range(-10, 10)],
+    ]
+    for sources, facet_off in itertools.product(
+        source_lists, [-100 * Ny, -10 * Ny, 0, 10 * Ny, 90 * Ny]
+    ):
+        lo = -(yB_size - 1) // 2 + facet_off
+        hi = lo + yB_size - 1
+        sources = [(i, min(max(x, lo), hi)) for i, x in sources]
+        facet = make_facet_from_sources(sources, N, yB_size, [facet_off])
+        assert np.sum(facet) == sum(s[0] for s in sources)
+
+        prepped = core.prepare_facet(facet, facet_off, axis=0)
+        for sg_off in [0, Nx, -Nx, N]:
+            contrib = core.extract_from_facet(prepped, sg_off, axis=0)
+            acc = core.add_to_subgrid(contrib, facet_off, axis=0)
+            subgrid = np.asarray(core.finish_subgrid(acc, sg_off, xA_size))
+            expected = make_subgrid_from_sources(sources, N, xA_size, [sg_off])
+            np.testing.assert_array_almost_equal(
+                subgrid, expected, decimal=8, err_msg=str(sources)
+            )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_facet_to_subgrid_vs_dft_2d(backend):
+    core = make_core(backend)
+    N, xA, yB = PARAMS["N"], PARAMS["xA_size"], PARAMS["yB_size"]
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    cases = [
+        [(1, 1, 2)],
+        [(1 / 8, 20, 4), (2 / 8, 2, 5), (3 / 8, -5, -4)],
+    ]
+    for sources, facet_offs in itertools.product(
+        cases, [[0, 0], [Ny, Ny], [-Ny, Ny], [0, -Ny]]
+    ):
+        facet = make_facet_from_sources(sources, N, yB, facet_offs)
+        assert np.sum(facet) == sum(s[0] for s in sources)
+        prepped = core.prepare_facet(
+            core.prepare_facet(facet, facet_offs[0], axis=0),
+            facet_offs[1],
+            axis=1,
+        )
+        for sg_offs in [[0, 0], [0, Nx], [Nx, 0], [-Nx, -Nx]]:
+            contrib = core.extract_from_facet(
+                core.extract_from_facet(prepped, sg_offs[0], axis=0),
+                sg_offs[1],
+                axis=1,
+            )
+            acc = core.add_to_subgrid(
+                core.add_to_subgrid(contrib, facet_offs[0], axis=0),
+                facet_offs[1],
+                axis=1,
+            )
+            subgrid = np.asarray(core.finish_subgrid(acc, sg_offs, xA))
+            expected = make_subgrid_from_sources(sources, N, xA, sg_offs)
+            np.testing.assert_array_almost_equal(subgrid, expected, decimal=8)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("xA_size", [228, 227])
+@pytest.mark.parametrize("yB_size", [416, 415])
+def test_subgrid_to_facet_constant(backend, xA_size, yB_size):
+    core = make_core(backend)
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    for val, sg_off in itertools.product([1, 0.1], Nx * np.array([-9, 0, 7])):
+        prepped = core.prepare_subgrid(
+            (val / xA_size) * np.ones(xA_size), int(sg_off)
+        )
+        for facet_off in Ny * np.array([-9, -1, 0, 5]):
+            extracted = core.extract_from_subgrid(prepped, int(facet_off), axis=0)
+            acc = core.add_to_facet(extracted, int(sg_off), axis=0)
+            facet = np.asarray(
+                core.finish_facet(acc, int(facet_off), yB_size, axis=0)
+            )
+            np.testing.assert_array_almost_equal(
+                facet[yB_size // 2 - facet_off], val, decimal=13
+            )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("xA_size", [228, 227])
+@pytest.mark.parametrize("yB_size", [416, 415])
+def test_subgrid_to_facet_vs_oracle_1d(backend, xA_size, yB_size):
+    core = make_core(backend)
+    N = PARAMS["N"]
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    source_lists = [[(1, 0)], [(2, 1)], [(1, -3)], [(-0.1, 5)]]
+    for sources, sg_off in itertools.product(
+        source_lists, Nx * np.array([-9, 0, 4, 7])
+    ):
+        sg_off = int(sg_off)
+        subgrid = (
+            make_subgrid_from_sources(sources, N, xA_size, [sg_off])
+            / xA_size
+            * N
+        )
+        prepped = core.prepare_subgrid(subgrid, sg_off)
+        for facet_off in Ny * np.array([-9, 0, 3, 7]):
+            facet_off = int(facet_off)
+            extracted = core.extract_from_subgrid(prepped, facet_off, axis=0)
+            acc = core.add_to_facet(extracted, sg_off, axis=0)
+            facet = np.asarray(
+                core.finish_facet(acc, facet_off, yB_size, axis=0)
+            )
+            expected = make_facet_from_sources(sources, N, yB_size, [facet_off])
+            np.testing.assert_array_almost_equal(
+                facet[expected != 0], expected[expected != 0], decimal=11
+            )
+            # sidelobes stay below the main peak
+            if sources[0][0] > 0:
+                np.testing.assert_array_less(
+                    facet[expected == 0], np.max(expected)
+                )
+            else:
+                np.testing.assert_array_less(
+                    -facet[expected == 0], np.max(-expected)
+                )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_subgrid_to_facet_vs_oracle_2d(backend):
+    core = make_core(backend)
+    N, xA, yB = PARAMS["N"], PARAMS["xA_size"], PARAMS["yB_size"]
+    Nx, Ny = core.subgrid_off_step, core.facet_off_step
+
+    source_lists = [[(1, 0, 0)], [(1, 20, 4)], [(3, -5, 4)]]
+    for sources, sg_offs in itertools.product(
+        source_lists, [[0, 0], [0, Nx], [Nx, 0], [-Nx, -Nx]]
+    ):
+        subgrid = (
+            make_subgrid_from_sources(sources, N, xA, sg_offs)
+            / xA**2
+            * N**2
+        )
+        prepped = core.prepare_subgrid(subgrid, sg_offs)
+        for facet_offs in [[0, 0], [Ny, Ny], [-Ny, Ny], [0, -Ny]]:
+            extracted = core.extract_from_subgrid(
+                core.extract_from_subgrid(prepped, facet_offs[0], axis=0),
+                facet_offs[1],
+                axis=1,
+            )
+            acc = core.add_to_facet(
+                core.add_to_facet(extracted, sg_offs[0], axis=0),
+                sg_offs[1],
+                axis=1,
+            )
+            facet = np.asarray(
+                core.finish_facet(
+                    core.finish_facet(acc, facet_offs[0], yB, axis=0),
+                    facet_offs[1],
+                    yB,
+                    axis=1,
+                )
+            )
+            expected = make_facet_from_sources(sources, N, yB, facet_offs)
+            np.testing.assert_array_almost_equal(
+                facet[expected != 0], expected[expected != 0], decimal=11
+            )
+            np.testing.assert_array_less(
+                facet[expected == 0], np.max(expected)
+            )
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_out_parameter_compat(backend):
+    """The reference-style out=/add semantics are honoured."""
+    core = make_core(backend)
+    rng = np.random.default_rng(0)
+    c1 = rng.normal(size=core.xM_yN_size) + 0j
+    c2 = rng.normal(size=core.xM_yN_size) + 0j
+
+    a = np.asarray(core.add_to_subgrid(c1, 0, axis=0))
+    out = np.zeros(core.xM_size, dtype=complex)
+    out = np.asarray(core.add_to_subgrid(c1, 0, axis=0, out=out))
+    np.testing.assert_allclose(out, a)
+    # adding accumulates
+    out2 = np.array(a)
+    out2 = np.asarray(core.add_to_subgrid(c2, 4, axis=0, out=out2))
+    expected = a + np.asarray(core.add_to_subgrid(c2, 4, axis=0))
+    np.testing.assert_allclose(out2, expected)
+
+
+def test_backends_bit_compatible():
+    """numpy and jax backends agree to float64 round-off on a full chain."""
+    N, yB, xA = PARAMS["N"], PARAMS["yB_size"], PARAMS["xA_size"]
+    cores = {b: make_core(b) for b in BACKENDS}
+    sources = [(1.0, 3), (0.25, -40)]
+    facet = make_facet_from_sources(sources, N, yB, [4])
+    results = {}
+    for b, core in cores.items():
+        prepped = core.prepare_facet(facet, 4, axis=0)
+        contrib = core.extract_from_facet(prepped, 2, axis=0)
+        acc = core.add_to_subgrid(contrib, 4, axis=0)
+        results[b] = np.asarray(core.finish_subgrid(acc, 2, xA))
+    np.testing.assert_allclose(
+        results["numpy"], results["jax"], rtol=0, atol=1e-14
+    )

@@ -1,0 +1,213 @@
+"""Tier-1 tests: L0 primitives.
+
+Covers the same ground as the reference's test_fourier_algorithm.py —
+pad/extract centring conventions (even and odd), shifted FFTs, coordinates,
+wrapped gather/scatter vs explicit roll formulations, the source-model
+oracle (including the fft(subgrid) == facet duality), and mask generation.
+"""
+
+import numpy as np
+import pytest
+
+import swiftly_tpu.ops.numpy_backend as npk
+import swiftly_tpu.ops.primitives as jxk
+from swiftly_tpu.ops.oracle import (
+    generate_masks,
+    make_facet_from_sources,
+    make_subgrid_from_sources,
+    mask_from_slices,
+)
+
+BACKENDS = [npk, jxk]
+
+
+def ids(p):
+    return "numpy" if p is npk else "jax"
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+@pytest.mark.parametrize("n0,n1", [(4, 8), (5, 8), (4, 9), (5, 9), (6, 6)])
+def test_pad_extract_roundtrip_1d(p, n0, n1):
+    a = np.arange(1, n0 + 1).astype(complex)
+    padded = np.asarray(p.pad_mid(a, n1, 0))
+    assert padded.shape == (n1,)
+    # centre convention: source occupies [n1//2 - n0//2, ...)
+    start = n1 // 2 - n0 // 2
+    np.testing.assert_array_equal(padded[start : start + n0], a)
+    assert np.sum(np.abs(padded)) == np.sum(np.abs(a))
+    # extraction inverts padding
+    np.testing.assert_array_equal(np.asarray(p.extract_mid(padded, n0, 0)), a)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+def test_pad_extract_2d_axes(p):
+    a = np.outer(np.arange(1, 5), np.arange(1, 6)).astype(complex)
+    out = np.asarray(p.pad_mid(p.pad_mid(a, 8, 0), 9, 1))
+    assert out.shape == (8, 9)
+    back = np.asarray(p.extract_mid(p.extract_mid(out, 5, 1), 4, 0))
+    np.testing.assert_array_equal(back, a)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+@pytest.mark.parametrize("n", [8, 9])
+def test_extract_mid_odd_keeps_reference_window(p, n):
+    # For odd n the reference keeps [c - n//2, c + n//2 + 1); check via
+    # explicit slice of a larger array
+    a = np.arange(16).astype(complex)
+    got = np.asarray(p.extract_mid(a, n, 0))
+    c = 8
+    np.testing.assert_array_equal(got, a[c - n // 2 : c - n // 2 + n])
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+def test_fft_delta_and_constant(p):
+    # delta at centre -> constant spectrum; constant -> delta at centre
+    n = 16
+    delta = np.zeros(n, dtype=complex)
+    delta[n // 2] = 1
+    np.testing.assert_allclose(np.asarray(p.fft(delta, 0)), np.ones(n), atol=1e-14)
+    const = np.ones(n, dtype=complex)
+    expected = np.zeros(n, dtype=complex)
+    expected[n // 2] = n
+    np.testing.assert_allclose(np.asarray(p.fft(const, 0)), expected, atol=1e-13)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+@pytest.mark.parametrize("n", [12, 13])
+def test_fft_ifft_roundtrip(p, n):
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(n, 7)) + 1j * rng.normal(size=(n, 7))
+    back = np.asarray(p.ifft(p.fft(a, 0), 0))
+    np.testing.assert_allclose(back, a, atol=1e-13)
+    # 2D: both axes, matches numpy's own centred 2D transform
+    both = np.asarray(p.fft(p.fft(a, 0), 1))
+    expected = np.fft.fftshift(np.fft.fft2(np.fft.ifftshift(a)))
+    np.testing.assert_allclose(both, expected, atol=1e-11)
+
+
+def test_coordinates():
+    for n in (8, 9, 10):
+        c = jxk.coordinates(n)
+        assert len(c) == n
+        assert c[n // 2] == 0
+        assert c.min() >= -0.5 and c.max() <= 0.5
+    np.testing.assert_allclose(jxk.coordinates(4), [-0.5, -0.25, 0, 0.25])
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+@pytest.mark.parametrize("shift", [-17, -3, 0, 2, 5, 23])
+@pytest.mark.parametrize("n", [4, 5])
+def test_wrapped_extract_equals_roll_extract(p, shift, n):
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(12, 3)) + 0j
+    got = np.asarray(p.wrapped_extract(a, n, shift, 0))
+    expected = np.asarray(p.extract_mid(np.roll(a, -shift, axis=0), n, 0))
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+@pytest.mark.parametrize("shift", [-17, -3, 0, 2, 5, 23])
+@pytest.mark.parametrize("m", [4, 5])
+def test_wrapped_embed_equals_pad_roll(p, shift, m):
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(m, 3)) + 0j
+    got = np.asarray(p.wrapped_embed(a, 12, shift, 0))
+    expected = np.roll(np.asarray(p.pad_mid(a, 12, 0)), shift, axis=0)
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+def test_wrapped_embed_extract_adjoint(p):
+    # <embed(x), y> == <x, extract(y)> for every shift: the ops are adjoints
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=5) + 1j * rng.normal(size=5)
+    y = rng.normal(size=12) + 1j * rng.normal(size=12)
+    for shift in (-4, 0, 3, 11):
+        lhs = np.vdot(np.asarray(p.wrapped_embed(x, 12, shift, 0)), y)
+        rhs = np.vdot(x, np.asarray(p.wrapped_extract(y, 5, shift, 0)))
+        np.testing.assert_allclose(lhs, rhs, atol=1e-14)
+
+
+@pytest.mark.parametrize("p", BACKENDS, ids=ids)
+def test_broadcast_along(p):
+    v = np.arange(3).astype(float)
+    assert np.asarray(p.broadcast_along(v, 2, 0)).shape == (3, 1)
+    assert np.asarray(p.broadcast_along(v, 2, 1)).shape == (1, 3)
+    assert np.asarray(p.broadcast_along(v, 3, 1)).shape == (1, 3, 1)
+
+
+# --- oracle ---------------------------------------------------------------
+
+
+def test_facet_from_sources_basic():
+    # single unit source at centre of a centred facet
+    facet = make_facet_from_sources([(1, 0)], 64, 16, [0])
+    expected = np.zeros(16)
+    expected[8] = 1
+    np.testing.assert_array_equal(facet.real, expected)
+    # source outside the facet window is dropped
+    facet = make_facet_from_sources([(1, 30)], 64, 16, [0])
+    assert np.all(facet == 0)
+    # offset facet picks it up
+    facet = make_facet_from_sources([(1, 30)], 64, 16, [30])
+    assert facet[8] == 1
+    # wrap-around: a source at -31 appears in a facet offset by +33
+    facet = make_facet_from_sources([(1, -31)], 64, 16, [33])
+    assert np.sum(facet) == 1
+
+
+def test_facet_from_sources_2d_and_mask():
+    facet = make_facet_from_sources(
+        [(2, 1, 2)], 64, 16, [0, 0], [np.ones(16), np.zeros(16)]
+    )
+    assert np.all(facet == 0)
+    facet = make_facet_from_sources([(2, 1, 2)], 64, 16, [0, 0])
+    assert facet[9, 10] == 2 and np.sum(np.abs(facet)) == 2
+
+
+def test_subgrid_from_sources_matches_explicit_dft():
+    N, size = 64, 8
+    sources = [(1.5, 3, -2), (-0.5, 0, 5)]
+    offs = [4, -6]
+    got = make_subgrid_from_sources(sources, N, size, offs)
+    us = np.arange(offs[0] - size // 2, offs[0] + (size + 1) // 2)
+    vs = np.arange(offs[1] - size // 2, offs[1] + (size + 1) // 2)
+    expected = np.zeros((size, size), dtype=complex)
+    for i, u in enumerate(us):
+        for j, v in enumerate(vs):
+            for inten, x, y in sources:
+                expected[i, j] += (
+                    inten / N**2 * np.exp(2j * np.pi * (u * x + v * y) / N)
+                )
+    np.testing.assert_allclose(got, expected, atol=1e-13)
+
+
+@pytest.mark.parametrize("size", [32, 33])
+def test_facet_subgrid_duality(size):
+    """When chunk size == image size, fft(ifftshifted facet) == subgrid."""
+    N = size
+    sources = [(1, 2), (0.5, -3)]
+    facet = make_facet_from_sources(sources, N, N, [0])
+    subgrid = make_subgrid_from_sources(sources, N, N, [0])
+    via_fft = np.asarray(npk.ifft(facet, 0))
+    np.testing.assert_allclose(via_fft, subgrid, atol=1e-13)
+
+
+def test_generate_masks_partition():
+    N = 64
+    offsets = np.array([0, 16, 32, 48])
+    masks = generate_masks(N, 24, offsets)
+    assert masks.shape == (4, 24)
+    # each mask covers exactly the chunk width, total covers the image once
+    assert masks.sum() == N
+    # ownership: pixel (off - 24//2 + i) belongs to exactly one mask
+    owners = np.zeros(N, dtype=int)
+    for off, m in zip(offsets, masks):
+        for i in range(24):
+            owners[(off - 12 + i) % N] += m[i]
+    np.testing.assert_array_equal(owners, np.ones(N, dtype=int))
+
+
+def test_mask_from_slices():
+    m = mask_from_slices([slice(0, 3), slice(5, 7)], 8)
+    np.testing.assert_array_equal(m, [1, 1, 1, 0, 0, 1, 1, 0])
