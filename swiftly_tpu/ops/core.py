@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import numpy_backend as npk
+from . import planar_backend as plk
 from . import primitives as jxk
 from .pswf import pswf_fb, pswf_fn, pswf_samples
 
@@ -79,7 +80,7 @@ def prepare_facet_math(p, Fb, yN_size, facet, facet_off, axis):
     """
     n = facet.shape[axis]
     fb = p.extract_mid(Fb, n, 0)
-    weighted = facet * p.broadcast_along(fb, facet.ndim, axis)
+    weighted = facet * p.broadcast_along(fb, p.ndim(facet), axis)
     embedded = p.wrapped_embed(weighted, yN_size, facet_off, axis)
     return p.ifft(embedded, axis)
 
@@ -107,7 +108,7 @@ def add_to_subgrid_math(p, Fn, xM_size, N, contrib, facet_off, axis):
     """
     scaled = facet_off * xM_size // N
     spectrum = p.roll_axis(p.fft(contrib, axis), -scaled, axis)
-    windowed = spectrum * p.broadcast_along(Fn, contrib.ndim, axis)
+    windowed = spectrum * p.broadcast_along(Fn, p.ndim(contrib), axis)
     return p.wrapped_embed(windowed, xM_size, scaled, axis)
 
 
@@ -117,7 +118,7 @@ def finish_subgrid_math(p, subgrid_size, summed, subgrid_offs):
     Parity: reference ``finish_subgrid`` (``core.py:287-325``).
     """
     out = summed
-    for axis in range(out.ndim):
+    for axis in range(p.ndim(out)):
         out = p.wrapped_extract(
             p.ifft(out, axis), subgrid_size, subgrid_offs[axis], axis
         )
@@ -130,7 +131,7 @@ def prepare_subgrid_math(p, xM_size, subgrid, subgrid_offs):
     Parity: reference ``prepare_subgrid`` (``core.py:328-368``).
     """
     out = subgrid
-    for axis in range(out.ndim):
+    for axis in range(p.ndim(out)):
         out = p.fft(p.wrapped_embed(out, xM_size, subgrid_offs[axis], axis), axis)
     return out
 
@@ -142,7 +143,7 @@ def extract_from_subgrid_math(p, Fn, xM_yN_size, xM_size, N, prep_subgrid, facet
     """
     scaled = facet_off * xM_size // N
     window = p.wrapped_extract(prep_subgrid, xM_yN_size, scaled, axis)
-    windowed = window * p.broadcast_along(Fn, window.ndim, axis)
+    windowed = window * p.broadcast_along(Fn, p.ndim(window), axis)
     return p.ifft(p.roll_axis(windowed, scaled, axis), axis)
 
 
@@ -164,7 +165,7 @@ def finish_facet_math(p, Fb, facet_size, summed, facet_off, axis):
     """
     fb = p.extract_mid(Fb, facet_size, 0)
     window = p.wrapped_extract(p.fft(summed, axis), facet_size, facet_off, axis)
-    return window * p.broadcast_along(fb, window.ndim, axis)
+    return window * p.broadcast_along(fb, p.ndim(window), axis)
 
 
 # ---------------------------------------------------------------------------
@@ -237,6 +238,21 @@ class SwiftlyCore:
             self._Fb = jnp.asarray(fb, dtype=real)
             self._Fn = jnp.asarray(fn, dtype=real)
             self._jit_cache = {}
+        elif backend == "planar":
+            # TPU-native path: complex data as (..., 2) real pairs, FFT via
+            # MXU matmuls. The only backend that runs on TPUs without
+            # complex/FFT support (which includes this environment's).
+            self._p = plk
+            if dtype is None:
+                dtype = (
+                    jnp.float64
+                    if jax.config.jax_enable_x64
+                    else jnp.float32
+                )
+            self.dtype = jnp.dtype(dtype)
+            self._Fb = jnp.asarray(fb, dtype=self.dtype)
+            self._Fn = jnp.asarray(fn, dtype=self.dtype)
+            self._jit_cache = {}
         else:
             raise ValueError(f"Unknown SwiFTly backend: {backend}")
 
@@ -275,7 +291,26 @@ class SwiftlyCore:
     def _prep(self, a):
         if self.backend == "numpy":
             return np.asarray(a, dtype=complex)
+        if self.backend == "planar":
+            if not np.iscomplexobj(a) and a.shape and a.shape[-1] == 2:
+                return jnp.asarray(a, dtype=self.dtype)  # already planar
+            return plk.to_planar(a, dtype=self.dtype)
         return jnp.asarray(a, dtype=self.dtype)
+
+    def to_planar(self, a):
+        """Convert complex input to this core's planar representation."""
+        return plk.to_planar(a, dtype=self.dtype)
+
+    @staticmethod
+    def from_planar(a):
+        """Convert a planar (..., 2) result back to numpy complex."""
+        return plk.from_planar(a)
+
+    def as_complex(self, a) -> np.ndarray:
+        """Return any backend's result as a numpy complex array."""
+        if self.backend == "planar":
+            return plk.from_planar(a)
+        return np.asarray(a)
 
     # -- facet -> subgrid --------------------------------------------------
 
@@ -319,12 +354,11 @@ class SwiftlyCore:
 
     def finish_subgrid(self, summed_contribs, subgrid_off, subgrid_size, out=None):
         """Finish a subgrid from summed contributions (all axes at once)."""
-        offs = self._as_offsets(subgrid_off, summed_contribs.ndim)
+        data = self._prep(summed_contribs)
+        offs = self._as_offsets(subgrid_off, self._p.ndim(data))
         fn = functools.partial(finish_subgrid_math, self._p, subgrid_size)
         return _apply_out(
-            self._run(
-                "fs", fn, self._prep(summed_contribs), offs, static=(subgrid_size,)
-            ),
+            self._run("fs", fn, data, offs, static=(subgrid_size,)),
             out,
         )
 
@@ -332,9 +366,10 @@ class SwiftlyCore:
 
     def prepare_subgrid(self, subgrid, subgrid_off, out=None):
         """Embed + FFT a subgrid into image space (all axes at once)."""
-        offs = self._as_offsets(subgrid_off, subgrid.ndim)
+        data = self._prep(subgrid)
+        offs = self._as_offsets(subgrid_off, self._p.ndim(data))
         fn = functools.partial(prepare_subgrid_math, self._p, self.xM_size)
-        return _apply_out(self._run("ps", fn, self._prep(subgrid), offs), out)
+        return _apply_out(self._run("ps", fn, data, offs), out)
 
     def extract_from_subgrid(self, prep_subgrid, facet_off, axis, out=None):
         """Extract a subgrid's windowed contribution to one facet (per axis)."""

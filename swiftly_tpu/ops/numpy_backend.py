@@ -24,6 +24,11 @@ __all__ = [
 ]
 
 
+def ndim(a) -> int:
+    """Logical dimensionality of `a`."""
+    return a.ndim
+
+
 def broadcast_along(vec, ndim: int, axis: int):
     """Reshape a 1D vector so it broadcasts along `axis` of an `ndim` array."""
     shape = [1] * ndim

@@ -37,6 +37,12 @@ __all__ = [
 ]
 
 
+def ndim(a) -> int:
+    """Logical dimensionality of `a` (see planar_backend for why this
+    is namespace-provided rather than ``a.ndim``)."""
+    return a.ndim
+
+
 def coordinates(n: int) -> np.ndarray:
     """1D coordinate array spanning [-0.5, 0.5) with 0 at index n//2.
 
