@@ -1,0 +1,58 @@
+"""swiftly-tpu: TPU-native streaming distributed Fourier transform.
+
+Bidirectional facet <-> subgrid transforms between image space and uv-grid
+space that never materialise the full N x N plane, built from scratch for
+TPU (JAX/XLA; planar-complex matmul FFT; facet-sharded device meshes with
+psum reductions). Capability parity with
+ska-telescope/ska-sdp-distributed-fourier-transform ("SwiFTly").
+"""
+
+from .api import (
+    FacetConfig,
+    FlightQueue,
+    LRUCache,
+    SubgridConfig,
+    SwiftlyBackward,
+    SwiftlyConfig,
+    SwiftlyForward,
+    check_facet,
+    check_residual,
+    check_subgrid,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+    make_sparse_facet_cover,
+    make_subgrid,
+    sparse_fov_cover_offsets,
+)
+from .models import SWIFT_CONFIGS
+from .ops import (
+    SwiftlyCore,
+    make_facet_from_sources,
+    make_subgrid_from_sources,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "FacetConfig",
+    "FlightQueue",
+    "LRUCache",
+    "SWIFT_CONFIGS",
+    "SubgridConfig",
+    "SwiftlyBackward",
+    "SwiftlyConfig",
+    "SwiftlyCore",
+    "SwiftlyForward",
+    "check_facet",
+    "check_residual",
+    "check_subgrid",
+    "make_facet",
+    "make_facet_from_sources",
+    "make_full_facet_cover",
+    "make_full_subgrid_cover",
+    "make_sparse_facet_cover",
+    "make_subgrid",
+    "make_subgrid_from_sources",
+    "sparse_fov_cover_offsets",
+]

@@ -1,0 +1,374 @@
+"""Streaming forward/backward API.
+
+`SwiftlyForward` streams subgrids out of a set of facets; `SwiftlyBackward`
+streams subgrids in and accumulates facets. Both bound their working set:
+
+* prepared facets (`BF_Fs`) are computed once and reused for every subgrid;
+* per-column intermediates are cached/accumulated in an LRU keyed by the
+  subgrid column offset `off0` — forward recomputes on miss, backward folds
+  the evicted column into the per-facet accumulators;
+* a flight queue caps the number of in-flight device computations
+  (JAX dispatch is asynchronous; the queue blocks on the oldest result,
+  which is the TPU equivalent of the reference's Dask
+  `TaskQueue`/`distributed.wait` backpressure, api.py:466-522).
+
+Subgrids may be produced/consumed in any order — every accumulation is a
+sum of linear contributions (the shuffle-order test relies on this).
+
+API parity: reference SwiftlyForward/SwiftlyBackward
+(/root/reference/src/ska_sdp_exec_swiftly/api.py:217-463), re-designed for
+single-program batched execution over stacked facets.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .models.config import FacetConfig, SubgridConfig, SwiftlyConfig
+from .models.covers import (
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+    make_sparse_facet_cover,
+    sparse_fov_cover_offsets,
+)
+from .ops.oracle import make_facet_from_sources, make_subgrid_from_sources
+from .parallel import batched
+
+log = logging.getLogger("swiftly-tpu")
+
+__all__ = [
+    "FacetConfig",
+    "SubgridConfig",
+    "SwiftlyConfig",
+    "SwiftlyForward",
+    "SwiftlyBackward",
+    "FlightQueue",
+    "LRUCache",
+    "check_facet",
+    "check_residual",
+    "check_subgrid",
+    "make_facet",
+    "make_full_facet_cover",
+    "make_full_subgrid_cover",
+    "make_sparse_facet_cover",
+    "make_subgrid",
+    "sparse_fov_cover_offsets",
+]
+
+
+# ---------------------------------------------------------------------------
+# Oracle helpers (host-side)
+# ---------------------------------------------------------------------------
+
+
+def make_facet(image_size, facet_config, sources):
+    """Build a facet's data from a source list (test/demo input)."""
+    return make_facet_from_sources(
+        sources,
+        image_size,
+        facet_config.size,
+        [facet_config.off0, facet_config.off1],
+        [facet_config.mask0, facet_config.mask1],
+    )
+
+
+def make_subgrid(image_size, sg_config, sources):
+    """Build a subgrid's data by direct DFT (test/demo input)."""
+    return make_subgrid_from_sources(
+        sources,
+        image_size,
+        sg_config.size,
+        [sg_config.off0, sg_config.off1],
+        [sg_config.mask0, sg_config.mask1],
+    )
+
+
+def check_facet(image_size, facet_config, approx_facet, sources):
+    """RMS error of a computed facet vs the analytic source model."""
+    facet = make_facet(image_size, facet_config, sources)
+    return float(np.sqrt(np.mean(np.abs(facet - np.asarray(approx_facet)) ** 2)))
+
+
+def check_subgrid(image_size, sg_config, approx_subgrid, sources):
+    """RMS error of a computed subgrid vs the direct-DFT source model."""
+    approx_subgrid = np.asarray(approx_subgrid)
+    subgrid = make_subgrid_from_sources(
+        sources,
+        image_size,
+        approx_subgrid.shape[0],
+        [sg_config.off0, sg_config.off1],
+        [sg_config.mask0, sg_config.mask1],
+    )
+    return float(np.sqrt(np.mean(np.abs(subgrid - approx_subgrid) ** 2)))
+
+
+def check_residual(residual):
+    """RMS of a residual array."""
+    return float(np.sqrt(np.mean(np.abs(np.asarray(residual)) ** 2)))
+
+
+# ---------------------------------------------------------------------------
+# Working-set control
+# ---------------------------------------------------------------------------
+
+
+class LRUCache:
+    """Small LRU: bounds the number of live column buffers.
+
+    `set` returns the evicted (key, value) once capacity is exceeded —
+    eviction is what triggers the backward fold step. Parity: reference
+    LRUCache (api.py:525-590).
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._store = {}  # insertion-ordered; order == recency
+
+    def get(self, key):
+        """Return the cached value and refresh its recency, or None."""
+        if key not in self._store:
+            return None
+        value = self._store.pop(key)
+        self._store[key] = value
+        return value
+
+    def set(self, key, value):
+        """Insert/refresh; returns (evicted_key, evicted_value) or
+        (None, None)."""
+        self._store.pop(key, None)
+        self._store[key] = value
+        if len(self._store) <= self.capacity:
+            return None, None
+        oldest = next(iter(self._store))
+        return oldest, self._store.pop(oldest)
+
+    def pop_all(self):
+        """Drain the cache oldest-first, yielding (key, value)."""
+        while self._store:
+            oldest = next(iter(self._store))
+            yield oldest, self._store.pop(oldest)
+
+    def __len__(self):
+        return len(self._store)
+
+
+class FlightQueue:
+    """Bounds in-flight asynchronous device work.
+
+    JAX dispatches computations asynchronously; unbounded dispatch can
+    enqueue arbitrarily much device work and host memory. `admit` blocks on
+    the oldest in-flight result once `depth` computations are outstanding —
+    the streaming analogue of the reference's TaskQueue
+    (api.py:466-522).
+    """
+
+    def __init__(self, depth: int):
+        self.depth = depth
+        self._inflight = []
+
+    @staticmethod
+    def _ready(item):
+        if hasattr(item, "block_until_ready"):
+            item.block_until_ready()
+
+    def admit(self, arrays):
+        """Register newly dispatched arrays, blocking if the queue is full."""
+        if not isinstance(arrays, (list, tuple)):
+            arrays = [arrays]
+        self._inflight.extend(arrays)
+        while len(self._inflight) > self.depth:
+            self._ready(self._inflight.pop(0))
+
+    def drain(self):
+        """Block until all in-flight work completes."""
+        while self._inflight:
+            self._ready(self._inflight.pop(0))
+
+
+# ---------------------------------------------------------------------------
+# Facet stacking
+# ---------------------------------------------------------------------------
+
+
+class _FacetStack:
+    """Stacked facet metadata: offsets and realised masks as arrays."""
+
+    def __init__(self, facet_configs):
+        if not facet_configs:
+            raise ValueError("At least one facet is required")
+        sizes = {cfg.size for cfg in facet_configs}
+        if len(sizes) != 1:
+            raise ValueError("All facets must share one size")
+        self.size = sizes.pop()
+        self.configs = list(facet_configs)
+        self.offs0 = np.array([c.off0 for c in facet_configs])
+        self.offs1 = np.array([c.off1 for c in facet_configs])
+
+        def mask_row(mask):
+            return np.ones(self.size) if mask is None else np.asarray(mask)
+
+        self.masks0 = np.stack([mask_row(c.mask0) for c in facet_configs])
+        self.masks1 = np.stack([mask_row(c.mask1) for c in facet_configs])
+
+    def __len__(self):
+        return len(self.configs)
+
+
+def _subgrid_masks(sg_config):
+    size = sg_config.size
+    m0 = np.ones(size) if sg_config.mask0 is None else np.asarray(sg_config.mask0)
+    m1 = np.ones(size) if sg_config.mask1 is None else np.asarray(sg_config.mask1)
+    return m0, m1
+
+
+# ---------------------------------------------------------------------------
+# Forward: facets -> subgrids
+# ---------------------------------------------------------------------------
+
+
+class SwiftlyForward:
+    """Stream subgrids out of a facet set.
+
+    :param swiftly_config: SwiftlyConfig
+    :param facet_tasks: list of (FacetConfig, facet_data) pairs
+    :param lru_forward: number of column intermediates kept resident
+    :param queue_size: in-flight computation cap
+    """
+
+    def __init__(self, swiftly_config, facet_tasks, lru_forward=1,
+                 queue_size=20):
+        self.config = swiftly_config
+        self.core = swiftly_config.core
+        self.stack = _FacetStack([cfg for cfg, _ in facet_tasks])
+        self._facet_data = [data for _, data in facet_tasks]
+        self._BF_Fs = None
+        self.lru = LRUCache(lru_forward)
+        self.queue = FlightQueue(queue_size)
+
+    def _get_BF_Fs(self):
+        if self._BF_Fs is None:
+            facets = np.stack(
+                [np.asarray(d, dtype=complex) for d in self._facet_data]
+            )
+            self._BF_Fs = batched.prepare_facets_batch(
+                self.core, facets, self.stack.offs0
+            )
+        return self._BF_Fs
+
+    def _get_columns(self, off0):
+        cols = self.lru.get(off0)
+        if cols is None:
+            cols = batched.extract_columns_batch(
+                self.core, self._get_BF_Fs(), off0, self.stack.offs1
+            )
+            self.lru.set(off0, cols)
+        return cols
+
+    def get_subgrid_task(self, subgrid_config):
+        """Compute one subgrid (asynchronous device array)."""
+        cols = self._get_columns(subgrid_config.off0)
+        subgrid = batched.subgrid_from_columns_batch(
+            self.core,
+            cols,
+            self.stack.offs0,
+            self.stack.offs1,
+            subgrid_config.off0,
+            subgrid_config.off1,
+            subgrid_config.size,
+            _subgrid_masks(subgrid_config),
+        )
+        self.queue.admit([subgrid])
+        return subgrid
+
+
+# ---------------------------------------------------------------------------
+# Backward: subgrids -> facets
+# ---------------------------------------------------------------------------
+
+
+class SwiftlyBackward:
+    """Stream subgrids in; accumulate and finish facets.
+
+    :param swiftly_config: SwiftlyConfig
+    :param facets_config_list: FacetConfigs describing the output facets
+    :param lru_backward: number of column accumulators kept live
+    :param queue_size: in-flight computation cap
+    """
+
+    def __init__(self, swiftly_config, facets_config_list, lru_backward=1,
+                 queue_size=20):
+        self.config = swiftly_config
+        self.core = swiftly_config.core
+        self.stack = _FacetStack(facets_config_list)
+        self.lru = LRUCache(lru_backward)
+        self.queue = FlightQueue(queue_size)
+        self._MNAF_BMNAFs = None
+        self._finished = False
+
+    def _zeros(self, shape):
+        core = self.core
+        if core.backend == "numpy":
+            return np.zeros(shape, dtype=complex)
+        import jax.numpy as jnp
+
+        if core.backend == "planar":
+            return jnp.zeros(shape + (2,), dtype=core.dtype)
+        return jnp.zeros(shape, dtype=core.dtype)
+
+    def add_new_subgrid_task(self, subgrid_config, subgrid_data):
+        """Fold one subgrid into the streaming accumulators."""
+        if self._finished:
+            raise RuntimeError("finish() was already called")
+        core, stack = self.core, self.stack
+        off0, off1 = subgrid_config.off0, subgrid_config.off1
+
+        NAF_NAFs = batched.split_subgrid_batch(
+            core, subgrid_data, off0, off1, stack.offs0, stack.offs1
+        )
+
+        col = self.lru.get(off0)
+        if col is None:
+            col = self._zeros(
+                (len(stack), core.xM_yN_size, core.yN_size)
+            )
+        col = batched.accumulate_column_batch(core, NAF_NAFs, off1, col)
+
+        evicted_off0, evicted = self.lru.set(off0, col)
+        if evicted is not None:
+            self._fold_column(evicted_off0, evicted)
+        self.queue.admit([col])
+        return col
+
+    def _fold_column(self, off0, col):
+        core, stack = self.core, self.stack
+        if self._MNAF_BMNAFs is None:
+            self._MNAF_BMNAFs = self._zeros(
+                (len(stack), core.yN_size, stack.size)
+            )
+        self._MNAF_BMNAFs = batched.accumulate_facet_batch(
+            core, col, off0, stack.offs1, stack.masks1, stack.size,
+            self._MNAF_BMNAFs,
+        )
+        self.queue.admit([self._MNAF_BMNAFs])
+
+    def finish(self):
+        """Drain accumulators and return the finished facet stack
+        [F, yB, yB]."""
+        for off0, col in self.lru.pop_all():
+            self._fold_column(off0, col)
+        if self._MNAF_BMNAFs is None:
+            self._MNAF_BMNAFs = self._zeros(
+                (len(self.stack), self.core.yN_size, self.stack.size)
+            )
+        facets = batched.finish_facets_batch(
+            self.core,
+            self._MNAF_BMNAFs,
+            self.stack.offs0,
+            self.stack.masks0,
+            self.stack.size,
+        )
+        self.queue.drain()
+        self._finished = True
+        return facets

@@ -275,6 +275,26 @@ class SwiftlyCore:
             f"backend={self.backend!r})"
         )
 
+    def _key(self):
+        return (
+            type(self),
+            self.W,
+            self.N,
+            self.xM_size,
+            self.yN_size,
+            self.backend,
+            str(getattr(self, "dtype", None)),
+        )
+
+    # Hash/eq by defining parameters: cores are static arguments to the
+    # jitted batch kernels, and equal parameters imply identical window
+    # constants, so compiled programs are shared across equal cores.
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, SwiftlyCore) and self._key() == other._key()
+
     # -- backend dispatch --------------------------------------------------
 
     def _run(self, name, fn, *args, static=()):

@@ -1,0 +1,5 @@
+"""Parallel execution: batched kernels, device meshes, sharded pipelines."""
+
+from . import batched
+
+__all__ = ["batched"]

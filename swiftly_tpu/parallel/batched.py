@@ -1,0 +1,350 @@
+"""Batched execution kernels: whole facet stacks per XLA program.
+
+Where the reference schedules one Dask task per (facet, subgrid) pair
+(/root/reference/src/ska_sdp_exec_swiftly/api.py:263-279), the TPU path
+stacks all facets into one array and `vmap`s the per-axis primitives over
+the stack, with per-facet offsets as traced vectors. One jitted program
+then computes *every* facet's contribution to a subgrid and reduces them —
+on a device mesh the same reduction becomes a `psum` over the facet axis
+(see swiftly_tpu.parallel.sharded).
+
+All kernels take the (hashable) SwiftlyCore as a static argument; window
+constants embed as XLA constants. The numpy backend executes the same
+semantics with an eager loop, which keeps the streaming API
+backend-agnostic.
+
+Array conventions (complex backends; planar adds a trailing (re,im) axis):
+  facets       [F, yB, yB]     stacked facet data
+  BF_Fs        [F, yN, yB]     facets prepared along axis 0
+  NMBF_BFs     [F, m, yN]      one subgrid column's contributions (m=xM_yN)
+  NMBF_NMBFs   [F, m, m]       per-facet contribution to one subgrid
+  NAF_NAFs     [F, m, m]       per-facet contribution from one subgrid
+  NAF_MNAFs    [F, m, yN]      per-column backward accumulators
+  MNAF_BMNAFs  [F, yN, yB]     per-facet backward accumulators
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.core import (
+    add_to_facet_math,
+    add_to_subgrid_math,
+    extract_from_facet_math,
+    extract_from_subgrid_math,
+    finish_facet_math,
+    finish_subgrid_math,
+    prepare_facet_math,
+    prepare_subgrid_math,
+)
+
+__all__ = [
+    "accumulate_column_batch",
+    "accumulate_facet_batch",
+    "extract_columns_batch",
+    "finish_facets_batch",
+    "prepare_facets_batch",
+    "split_subgrid_batch",
+    "subgrid_from_columns_batch",
+]
+
+
+def _is_np(core):
+    return core.backend == "numpy"
+
+
+def _mask_along(p, data, mask, axis):
+    """Multiply `data` by a per-axis 0/1 mask (real vector)."""
+    return data * p.broadcast_along(mask, p.ndim(data), axis)
+
+
+# -- facet -> subgrid -------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _prepare_facets_j(core, facets, offs0):
+    fn = lambda facet, off: prepare_facet_math(
+        core._p, core._Fb, core.yN_size, facet, off, 0
+    )
+    return jax.vmap(fn)(facets, offs0)
+
+
+def prepare_facets_batch(core, facets, offs0):
+    """[F, yB, yB] -> BF_Fs [F, yN, yB]: prepare all facets along axis 0.
+
+    Done once per streaming session and reused for every subgrid
+    (reference `_get_BF_Fs`, api.py:281-298).
+    """
+    if _is_np(core):
+        return np.stack(
+            [
+                prepare_facet_math(
+                    core._p, core._Fb, core.yN_size, f, int(o), 0
+                )
+                for f, o in zip(facets, offs0)
+            ]
+        )
+    return _prepare_facets_j(core, core._prep(facets), jnp.asarray(offs0))
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _extract_columns_j(core, BF_Fs, off0, offs1):
+    def fn(BF_F, off1):
+        col = extract_from_facet_math(
+            core._p, core.xM_yN_size, core.N, core.yN_size, BF_F, off0, 0
+        )
+        return prepare_facet_math(
+            core._p, core._Fb, core.yN_size, col, off1, 1
+        )
+
+    return jax.vmap(fn)(BF_Fs, offs1)
+
+
+def extract_columns_batch(core, BF_Fs, off0, offs1):
+    """BF_Fs [F, yN, yB] -> NMBF_BFs [F, m, yN] for one subgrid column.
+
+    Axis-0 extraction at the column's off0 plus axis-1 preparation; shared
+    by every subgrid with this off0 (reference `extract_column`,
+    api_helper.py:200-210).
+    """
+    if _is_np(core):
+        out = []
+        for BF_F, off1 in zip(BF_Fs, offs1):
+            col = extract_from_facet_math(
+                core._p, core.xM_yN_size, core.N, core.yN_size,
+                BF_F, int(off0), 0,
+            )
+            out.append(
+                prepare_facet_math(
+                    core._p, core._Fb, core.yN_size, col, int(off1), 1
+                )
+            )
+        return np.stack(out)
+    return _extract_columns_j(
+        core, BF_Fs, jnp.asarray(off0), jnp.asarray(offs1)
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6))
+def _subgrid_from_columns_j(
+    core, NMBF_BFs, offs0, offs1, sg_offs, masks, subgrid_size
+):
+    p = core._p
+
+    def contrib(NMBF_BF, foff0, foff1):
+        NMBF_NMBF = extract_from_facet_math(
+            p, core.xM_yN_size, core.N, core.yN_size, NMBF_BF, sg_offs[1], 1
+        )
+        acc0 = add_to_subgrid_math(
+            p, core._Fn, core.xM_size, core.N, NMBF_NMBF, foff0, 0
+        )
+        return add_to_subgrid_math(
+            p, core._Fn, core.xM_size, core.N, acc0, foff1, 1
+        )
+
+    summed = jnp.sum(jax.vmap(contrib)(NMBF_BFs, offs0, offs1), axis=0)
+    subgrid = finish_subgrid_math(p, subgrid_size, summed, sg_offs)
+    subgrid = _mask_along(p, subgrid, masks[0], 0)
+    return _mask_along(p, subgrid, masks[1], 1)
+
+
+def subgrid_from_columns_batch(
+    core, NMBF_BFs, offs0, offs1, sg_off0, sg_off1, subgrid_size, masks
+):
+    """NMBF_BFs [F, m, yN] -> finished subgrid [xA, xA] for one subgrid.
+
+    Extracts the axis-1 contribution per facet, embeds both axes into the
+    padded-subgrid frame, sums over facets (the psum-able reduction),
+    finishes, and applies ownership masks (reference
+    `sum_and_finish_subgrid`, api_helper.py:73-112).
+    """
+    if _is_np(core):
+        p = core._p
+        summed = None
+        for NMBF_BF, foff0, foff1 in zip(NMBF_BFs, offs0, offs1):
+            NMBF_NMBF = extract_from_facet_math(
+                p, core.xM_yN_size, core.N, core.yN_size,
+                NMBF_BF, int(sg_off1), 1,
+            )
+            acc = add_to_subgrid_math(
+                p, core._Fn, core.xM_size, core.N, NMBF_NMBF, int(foff0), 0
+            )
+            acc = add_to_subgrid_math(
+                p, core._Fn, core.xM_size, core.N, acc, int(foff1), 1
+            )
+            summed = acc if summed is None else summed + acc
+        subgrid = finish_subgrid_math(
+            p, subgrid_size, summed, [int(sg_off0), int(sg_off1)]
+        )
+        subgrid = _mask_along(p, subgrid, masks[0], 0)
+        return _mask_along(p, subgrid, masks[1], 1)
+    return _subgrid_from_columns_j(
+        core,
+        NMBF_BFs,
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        jnp.asarray([sg_off0, sg_off1]),
+        [jnp.asarray(masks[0], core._Fb.dtype),
+         jnp.asarray(masks[1], core._Fb.dtype)],
+        subgrid_size,
+    )
+
+
+# -- subgrid -> facet -------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _split_subgrid_j(core, subgrid, sg_offs, offs0, offs1):
+    p = core._p
+    prepped = prepare_subgrid_math(p, core.xM_size, subgrid, sg_offs)
+
+    def extract(foff0, foff1):
+        e0 = extract_from_subgrid_math(
+            p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
+            prepped, foff0, 0,
+        )
+        return extract_from_subgrid_math(
+            p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
+            e0, foff1, 1,
+        )
+
+    return jax.vmap(extract)(offs0, offs1)
+
+
+def split_subgrid_batch(core, subgrid, sg_off0, sg_off1, offs0, offs1):
+    """Subgrid [xA, xA] -> NAF_NAFs [F, m, m]: contributions to all facets.
+
+    (Reference `prepare_and_split_subgrid`, api_helper.py:115-139.)
+    """
+    if _is_np(core):
+        p = core._p
+        prepped = prepare_subgrid_math(
+            p, core.xM_size, np.asarray(subgrid, dtype=complex),
+            [int(sg_off0), int(sg_off1)],
+        )
+        out = []
+        for foff0, foff1 in zip(offs0, offs1):
+            e0 = extract_from_subgrid_math(
+                p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
+                prepped, int(foff0), 0,
+            )
+            out.append(
+                extract_from_subgrid_math(
+                    p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
+                    e0, int(foff1), 1,
+                )
+            )
+        return np.stack(out)
+    return _split_subgrid_j(
+        core,
+        core._prep(subgrid),
+        jnp.asarray([sg_off0, sg_off1]),
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def _accumulate_column_j(core, NAF_NAFs, sg_off1, NAF_MNAFs):
+    fn = lambda c: add_to_facet_math(core._p, core.yN_size, core.N, c, sg_off1, 1)
+    return NAF_MNAFs + jax.vmap(fn)(NAF_NAFs)
+
+
+def accumulate_column_batch(core, NAF_NAFs, sg_off1, NAF_MNAFs):
+    """Fold one subgrid's NAF_NAFs [F, m, m] into the column accumulator
+    NAF_MNAFs [F, m, yN] (reference `accumulate_column`,
+    api_helper.py:142-152)."""
+    if _is_np(core):
+        for i, c in enumerate(NAF_NAFs):
+            NAF_MNAFs[i] += add_to_facet_math(
+                core._p, core.yN_size, core.N, c, int(sg_off1), 1
+            )
+        return NAF_MNAFs
+    return _accumulate_column_j(
+        core, NAF_NAFs, jnp.asarray(sg_off1), NAF_MNAFs
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _accumulate_facet_j(core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size,
+                        MNAF_BMNAFs):
+    p = core._p
+
+    def fold(NAF_MNAF, off1, mask1):
+        NAF_BMNAF = finish_facet_math(
+            p, core._Fb, facet_size, NAF_MNAF, off1, 1
+        )
+        NAF_BMNAF = _mask_along(p, NAF_BMNAF, mask1, 1)
+        return add_to_facet_math(p, core.yN_size, core.N, NAF_BMNAF, sg_off0, 0)
+
+    return MNAF_BMNAFs + jax.vmap(fold)(NAF_MNAFs, offs1, masks1)
+
+
+def accumulate_facet_batch(
+    core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size, MNAF_BMNAFs
+):
+    """Fold an evicted column accumulator into the per-facet accumulators.
+
+    Axis-1 finish + mask, then axis-0 embed at the column's sg_off0
+    (reference `accumulate_facet`, api_helper.py:155-179).
+    """
+    if _is_np(core):
+        p = core._p
+        for i, (NAF_MNAF, off1, mask1) in enumerate(
+            zip(NAF_MNAFs, offs1, masks1)
+        ):
+            NAF_BMNAF = finish_facet_math(
+                p, core._Fb, facet_size, NAF_MNAF, int(off1), 1
+            )
+            NAF_BMNAF = _mask_along(p, NAF_BMNAF, np.asarray(mask1), 1)
+            MNAF_BMNAFs[i] += add_to_facet_math(
+                p, core.yN_size, core.N, NAF_BMNAF, int(sg_off0), 0
+            )
+        return MNAF_BMNAFs
+    return _accumulate_facet_j(
+        core,
+        NAF_MNAFs,
+        jnp.asarray(sg_off0),
+        jnp.asarray(offs1),
+        jnp.asarray(masks1, core._Fb.dtype),
+        facet_size,
+        MNAF_BMNAFs,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def _finish_facets_j(core, MNAF_BMNAFs, offs0, masks0, facet_size):
+    p = core._p
+
+    def fin(MNAF_BMNAF, off0, mask0):
+        facet = finish_facet_math(
+            p, core._Fb, facet_size, MNAF_BMNAF, off0, 0
+        )
+        return _mask_along(p, facet, mask0, 0)
+
+    return jax.vmap(fin)(MNAF_BMNAFs, offs0, masks0)
+
+
+def finish_facets_batch(core, MNAF_BMNAFs, offs0, masks0, facet_size):
+    """MNAF_BMNAFs [F, yN, yB] -> finished facets [F, yB, yB]
+    (reference `finish_facet` wrapper, api_helper.py:182-197)."""
+    if _is_np(core):
+        p = core._p
+        out = []
+        for MNAF_BMNAF, off0, mask0 in zip(MNAF_BMNAFs, offs0, masks0):
+            facet = finish_facet_math(
+                p, core._Fb, facet_size, MNAF_BMNAF, int(off0), 0
+            )
+            out.append(_mask_along(p, facet, np.asarray(mask0), 0))
+        return np.stack(out)
+    return _finish_facets_j(
+        core,
+        MNAF_BMNAFs,
+        jnp.asarray(offs0),
+        jnp.asarray(masks0, core._Fb.dtype),
+        facet_size,
+    )

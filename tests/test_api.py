@@ -1,0 +1,132 @@
+"""Tier-3 tests: end-to-end streaming round trip.
+
+Mirrors the reference's test_api.py: full facet cover -> forward ->
+identity -> backward -> finished facets, RMS < 3e-10 per facet (float64),
+parameterised over queue depth, forward/backward LRU sizes, shuffled
+subgrid order (order independence of the streaming accumulators), and all
+backends.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SwiftlyBackward,
+    SwiftlyConfig,
+    SwiftlyForward,
+    check_facet,
+    check_subgrid,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+)
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+SOURCES = [(1, 1, 0)]
+
+
+def roundtrip(backend, queue_size, lru_forward, lru_backward, shuffle,
+              dtype=None):
+    config = SwiftlyConfig(backend=backend, dtype=dtype, **TEST_PARAMS)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_configs = make_full_facet_cover(config)
+
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+
+    fwd = SwiftlyForward(config, facet_tasks, lru_forward, queue_size)
+    bwd = SwiftlyBackward(config, facet_configs, lru_backward, queue_size)
+
+    if shuffle:
+        random.Random(42).shuffle(subgrid_configs)
+
+    sg_errors = []
+    for sg_config in subgrid_configs:
+        subgrid = fwd.get_subgrid_task(sg_config)
+        sg_errors.append(
+            check_subgrid(
+                config.image_size,
+                sg_config,
+                config.core.as_complex(subgrid),
+                SOURCES,
+            )
+        )
+        bwd.add_new_subgrid_task(sg_config, subgrid)
+
+    facets = bwd.finish()
+    facet_errors = [
+        check_facet(
+            config.image_size, fc, config.core.as_complex(facets[i]), SOURCES
+        )
+        for i, fc in enumerate(facet_configs)
+    ]
+    return sg_errors, facet_errors
+
+
+@pytest.mark.parametrize(
+    "queue_size,lru_forward,lru_backward,shuffle",
+    [
+        (100, 1, 1, False),
+        (100, 2, 1, False),
+        (200, 1, 2, True),
+        (8, 1, 1, True),
+    ],
+)
+def test_roundtrip_jax(queue_size, lru_forward, lru_backward, shuffle):
+    sg_errors, facet_errors = roundtrip(
+        "jax", queue_size, lru_forward, lru_backward, shuffle
+    )
+    assert max(sg_errors) < 3e-10
+    assert max(facet_errors) < 3e-10
+
+
+def test_roundtrip_numpy():
+    sg_errors, facet_errors = roundtrip("numpy", 100, 1, 1, False)
+    assert max(sg_errors) < 3e-10
+    assert max(facet_errors) < 3e-10
+
+
+def test_roundtrip_planar_f64():
+    sg_errors, facet_errors = roundtrip(
+        "planar", 100, 1, 1, True, dtype=np.float64
+    )
+    assert max(sg_errors) < 3e-10
+    assert max(facet_errors) < 3e-10
+
+
+def test_roundtrip_planar_f32():
+    """TPU-representative precision: relaxed thresholds."""
+    sg_errors, facet_errors = roundtrip(
+        "planar", 100, 1, 1, False, dtype=np.float32
+    )
+    assert max(sg_errors) < 1e-5
+    assert max(facet_errors) < 1e-4
+
+
+def test_shuffle_matches_ordered():
+    """Streaming accumulation is order-independent to round-off."""
+    _, ordered = roundtrip("jax", 100, 1, 1, False)
+    _, shuffled = roundtrip("jax", 100, 1, 1, True)
+    np.testing.assert_allclose(ordered, shuffled, atol=1e-12)
+
+
+def test_backward_finish_twice_raises():
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    bwd = SwiftlyBackward(config, facet_configs, 1, 10)
+    bwd.finish()
+    with pytest.raises(RuntimeError):
+        bwd.add_new_subgrid_task(make_full_subgrid_cover(config)[0], None)

@@ -1,0 +1,117 @@
+"""Tests for the plan layer: configs, covers, catalogue."""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import SWIFT_CONFIGS, SwiftlyConfig
+from swiftly_tpu.models import (
+    FacetConfig,
+    make_full_cover,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+    make_sparse_facet_cover,
+    sparse_fov_cover_offsets,
+)
+from swiftly_tpu.ops import validate_core_params
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+
+def test_catalogue_size_and_fields():
+    assert len(SWIFT_CONFIGS) == 244
+    for name, cfg in SWIFT_CONFIGS.items():
+        assert set(cfg) == {
+            "W", "fov", "N", "Nx", "yB_size", "yN_size", "yP_size",
+            "xA_size", "xM_size",
+        }, name
+
+
+def test_catalogue_constructible():
+    """Every catalogue entry satisfies the core's divisibility rules."""
+    for name, cfg in SWIFT_CONFIGS.items():
+        validate_core_params(cfg["N"], cfg["xM_size"], cfg["yN_size"])
+
+
+def test_catalogue_flagship_entries():
+    cfg = SWIFT_CONFIGS["64k[1]-n32k-512"]
+    assert cfg["N"] == 65536 and cfg["yN_size"] == 32768
+    assert cfg["xM_size"] == 512 and cfg["yB_size"] == 22528
+    assert SWIFT_CONFIGS["128k[1]-n32k-512"]["N"] == 131072
+
+
+def test_swiftly_config_properties():
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    assert config.image_size == 1024
+    assert config.max_facet_size == 416
+    assert config.max_subgrid_size == 228
+    assert config.internal_facet_size == 512
+    assert config.internal_subgrid_size == 256
+    assert config.contribution_size == 128
+    assert config.facet_off_step == 4
+    assert config.subgrid_off_step == 2
+    assert config.pswf_parameter == TEST_PARAMS["W"]
+
+
+def test_chunk_config_lazy_masks():
+    fc = FacetConfig(0, 0, 8, [[slice(1, 5)], 8], None)
+    np.testing.assert_array_equal(fc.mask0, [0, 1, 1, 1, 1, 0, 0, 0])
+    assert fc.mask1 is None
+    # realised arrays pass through
+    fc2 = FacetConfig(0, 0, 8, np.ones(8), None)
+    np.testing.assert_array_equal(fc2.mask0, np.ones(8))
+
+
+def test_full_cover_partitions_image():
+    """Each pixel of the image belongs to exactly one facet of the cover."""
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    for cover, chunk in [
+        (make_full_facet_cover(config), 416),
+        (make_full_subgrid_cover(config), 228),
+    ]:
+        N = config.image_size
+        n_chunks = int(np.ceil(N / chunk))
+        assert len(cover) == n_chunks * n_chunks
+        # check the 1D partition along each axis using the first row/col
+        own = np.zeros(N)
+        for cfg in cover[:n_chunks]:  # distinct off1, fixed off0
+            mask = cfg.mask1
+            for i in range(chunk):
+                own[(cfg.off1 - chunk // 2 + i) % N] += mask[i]
+        np.testing.assert_array_equal(own, np.ones(N))
+
+
+def test_full_cover_offsets_divisible():
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    for cfg in make_full_subgrid_cover(config):
+        assert cfg.off0 % config.subgrid_off_step == 0
+        assert cfg.off1 % config.subgrid_off_step == 0
+
+
+def test_sparse_cover_shapes():
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    offs, masks = sparse_fov_cover_offsets(config, config.image_size // 2)
+    assert len(offs) == len(masks) >= 1
+    step = config.facet_off_step
+    for off0, off1 in offs:
+        assert off0 % step == 0 and off1 % step == 0
+    cover = make_sparse_facet_cover(config.max_facet_size, offs, masks)
+    assert all(isinstance(c, FacetConfig) for c in cover)
+    assert all(c.size == 416 for c in cover)
+    # full-slice masks realise to all-ones
+    np.testing.assert_array_equal(cover[0].mask0, np.ones(416))
+
+
+def test_sparse_cover_rejects_bad_step():
+    # a facet size not divisible by the offset step must raise
+    params = dict(TEST_PARAMS, yB_size=418)
+    config = SwiftlyConfig(backend="numpy", **params)
+    with pytest.raises(ValueError):
+        sparse_fov_cover_offsets(config, 830)
