@@ -1,0 +1,105 @@
+"""Shared CLI and instrumentation helpers for the demo scripts.
+
+Parity: reference scripts/utils.py (CLI with @file argument support,
+human-readable sizes, transfer accounting) — re-based on JAX device/memory
+introspection instead of Dask worker logs.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+__all__ = ["cli_parser", "human_readable_size"]
+
+
+def human_readable_size(size: float, decimal_places: int = 3) -> str:
+    """Format a byte count with binary units."""
+    for unit in ["B", "KiB", "MiB", "GiB", "TiB", "PiB"]:
+        if size < 1024 or unit == "PiB":
+            break
+        size /= 1024
+    return f"{size:.{decimal_places}f} {unit}"
+
+
+def cli_parser(description: str) -> argparse.ArgumentParser:
+    """Common demo CLI. Supports @file argument files (one arg per line)."""
+    parser = argparse.ArgumentParser(
+        description=description,
+        fromfile_prefix_chars="@",
+    )
+    parser.add_argument(
+        "--swift_config",
+        type=str,
+        default="1k[1]-n512-256",
+        help="comma-separated catalogue key(s), see swiftly_tpu.SWIFT_CONFIGS",
+    )
+    parser.add_argument(
+        "--backend",
+        type=str,
+        default="jax",
+        choices=["jax", "planar", "numpy"],
+        help="numerical backend",
+    )
+    parser.add_argument(
+        "--precision",
+        type=str,
+        default="f64",
+        choices=["f32", "f64"],
+        help="working precision (f64 enables x64)",
+    )
+    parser.add_argument(
+        "--source_number",
+        type=int,
+        default=10,
+        help="number of random point sources in the test image",
+    )
+    parser.add_argument(
+        "--queue_size", type=int, default=20, help="in-flight work cap"
+    )
+    parser.add_argument(
+        "--lru_forward", type=int, default=1, help="forward column cache size"
+    )
+    parser.add_argument(
+        "--lru_backward", type=int, default=1,
+        help="backward column accumulator count",
+    )
+    parser.add_argument(
+        "--mesh_devices",
+        type=int,
+        default=0,
+        help="shard facets over this many devices (0 = single device)",
+    )
+    parser.add_argument(
+        "--profile_dir",
+        type=str,
+        default=None,
+        help="write a jax.profiler trace to this directory",
+    )
+    return parser
+
+
+def setup_jax(args):
+    """Apply precision/platform settings before first device use.
+
+    The complex backends ("jax", "numpy"+jax checks) cannot run on TPUs
+    without complex-dtype support, and float64 is CPU-only in practice —
+    route those to the CPU platform. The planar backend runs anywhere.
+    """
+    import jax
+
+    if args.precision == "f64":
+        jax.config.update("jax_enable_x64", True)
+    if args.backend != "planar" or args.precision == "f64":
+        jax.config.update("jax_platforms", "cpu")
+    return jax
+
+
+def make_sources(rng, count, image_size, fov=1.0):
+    """Random integer point sources within the field of view."""
+    lim = int(image_size // 2 * min(fov, 1.0)) - 1
+    return [
+        (float(rng.integers(1, 100)),
+         int(rng.integers(-lim, lim)),
+         int(rng.integers(-lim, lim)))
+        for _ in range(count)
+    ]

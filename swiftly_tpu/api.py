@@ -193,9 +193,14 @@ class FlightQueue:
 
 
 class _FacetStack:
-    """Stacked facet metadata: offsets and realised masks as arrays."""
+    """Stacked facet metadata: offsets and realised masks as arrays.
 
-    def __init__(self, facet_configs):
+    When running on a mesh the stack is zero-padded to a multiple of the
+    mesh size; padded entries have zero masks and contribute exact zeros
+    to every (linear) accumulation.
+    """
+
+    def __init__(self, facet_configs, pad_to: int = 1):
         if not facet_configs:
             raise ValueError("At least one facet is required")
         sizes = {cfg.size for cfg in facet_configs}
@@ -203,17 +208,53 @@ class _FacetStack:
             raise ValueError("All facets must share one size")
         self.size = sizes.pop()
         self.configs = list(facet_configs)
-        self.offs0 = np.array([c.off0 for c in facet_configs])
-        self.offs1 = np.array([c.off1 for c in facet_configs])
+        self.n_real = len(facet_configs)
+        n_pad = (-self.n_real) % pad_to
+        self.n_total = self.n_real + n_pad
 
         def mask_row(mask):
             return np.ones(self.size) if mask is None else np.asarray(mask)
 
-        self.masks0 = np.stack([mask_row(c.mask0) for c in facet_configs])
-        self.masks1 = np.stack([mask_row(c.mask1) for c in facet_configs])
+        zero_mask = np.zeros(self.size)
+        self.offs0 = np.array([c.off0 for c in facet_configs] + [0] * n_pad)
+        self.offs1 = np.array([c.off1 for c in facet_configs] + [0] * n_pad)
+        self.masks0 = np.stack(
+            [mask_row(c.mask0) for c in facet_configs] + [zero_mask] * n_pad
+        )
+        self.masks1 = np.stack(
+            [mask_row(c.mask1) for c in facet_configs] + [zero_mask] * n_pad
+        )
+
+    def pad_data(self, stacked):
+        """Zero-pad stacked per-facet data [n_real, ...] to [n_total, ...]."""
+        if self.n_total == self.n_real:
+            return stacked
+        pad = np.zeros((self.n_total - self.n_real,) + stacked.shape[1:],
+                       dtype=stacked.dtype)
+        return np.concatenate([stacked, pad])
 
     def __len__(self):
-        return len(self.configs)
+        return self.n_total
+
+
+def _mesh_size(mesh):
+    return 1 if mesh is None else mesh.devices.size
+
+
+def _place(core, mesh, arr, shard_facets: bool):
+    """Device-place an array: facet-sharded over the mesh or replicated.
+
+    With no mesh, returns the array unchanged (the batched kernels place
+    it on the default device)."""
+    if mesh is None:
+        return arr
+    import jax
+    from .parallel.mesh import facet_sharding, replicated_sharding
+
+    if np.iscomplexobj(arr):
+        arr = core._prep(np.asarray(arr))
+    sharding = facet_sharding(mesh) if shard_facets else replicated_sharding(mesh)
+    return jax.device_put(arr, sharding)
 
 
 def _subgrid_masks(sg_config):
@@ -241,19 +282,27 @@ class SwiftlyForward:
                  queue_size=20):
         self.config = swiftly_config
         self.core = swiftly_config.core
-        self.stack = _FacetStack([cfg for cfg, _ in facet_tasks])
+        self.mesh = getattr(swiftly_config, "mesh", None)
+        self.stack = _FacetStack(
+            [cfg for cfg, _ in facet_tasks], pad_to=_mesh_size(self.mesh)
+        )
         self._facet_data = [data for _, data in facet_tasks]
         self._BF_Fs = None
+        self._offs0 = _place(self.core, self.mesh, self.stack.offs0, True)
+        self._offs1 = _place(self.core, self.mesh, self.stack.offs1, True)
         self.lru = LRUCache(lru_forward)
         self.queue = FlightQueue(queue_size)
 
     def _get_BF_Fs(self):
         if self._BF_Fs is None:
-            facets = np.stack(
-                [np.asarray(d, dtype=complex) for d in self._facet_data]
+            facets = self.stack.pad_data(
+                np.stack(
+                    [np.asarray(d, dtype=complex) for d in self._facet_data]
+                )
             )
+            facets = _place(self.core, self.mesh, facets, True)
             self._BF_Fs = batched.prepare_facets_batch(
-                self.core, facets, self.stack.offs0
+                self.core, facets, self._offs0
             )
         return self._BF_Fs
 
@@ -261,7 +310,7 @@ class SwiftlyForward:
         cols = self.lru.get(off0)
         if cols is None:
             cols = batched.extract_columns_batch(
-                self.core, self._get_BF_Fs(), off0, self.stack.offs1
+                self.core, self._get_BF_Fs(), off0, self._offs1
             )
             self.lru.set(off0, cols)
         return cols
@@ -272,8 +321,8 @@ class SwiftlyForward:
         subgrid = batched.subgrid_from_columns_batch(
             self.core,
             cols,
-            self.stack.offs0,
-            self.stack.offs1,
+            self._offs0,
+            self._offs1,
             subgrid_config.off0,
             subgrid_config.off1,
             subgrid_config.size,
@@ -301,7 +350,14 @@ class SwiftlyBackward:
                  queue_size=20):
         self.config = swiftly_config
         self.core = swiftly_config.core
-        self.stack = _FacetStack(facets_config_list)
+        self.mesh = getattr(swiftly_config, "mesh", None)
+        self.stack = _FacetStack(
+            facets_config_list, pad_to=_mesh_size(self.mesh)
+        )
+        self._offs0 = _place(self.core, self.mesh, self.stack.offs0, True)
+        self._offs1 = _place(self.core, self.mesh, self.stack.offs1, True)
+        self._masks0 = _place(self.core, self.mesh, self.stack.masks0, True)
+        self._masks1 = _place(self.core, self.mesh, self.stack.masks1, True)
         self.lru = LRUCache(lru_backward)
         self.queue = FlightQueue(queue_size)
         self._MNAF_BMNAFs = None
@@ -314,8 +370,12 @@ class SwiftlyBackward:
         import jax.numpy as jnp
 
         if core.backend == "planar":
-            return jnp.zeros(shape + (2,), dtype=core.dtype)
-        return jnp.zeros(shape, dtype=core.dtype)
+            zeros = jnp.zeros(shape + (2,), dtype=core.dtype)
+        else:
+            zeros = jnp.zeros(shape, dtype=core.dtype)
+        if self.mesh is not None:
+            zeros = _place(core, self.mesh, zeros, True)
+        return zeros
 
     def add_new_subgrid_task(self, subgrid_config, subgrid_data):
         """Fold one subgrid into the streaming accumulators."""
@@ -325,7 +385,7 @@ class SwiftlyBackward:
         off0, off1 = subgrid_config.off0, subgrid_config.off1
 
         NAF_NAFs = batched.split_subgrid_batch(
-            core, subgrid_data, off0, off1, stack.offs0, stack.offs1
+            core, subgrid_data, off0, off1, self._offs0, self._offs1
         )
 
         col = self.lru.get(off0)
@@ -348,7 +408,7 @@ class SwiftlyBackward:
                 (len(stack), core.yN_size, stack.size)
             )
         self._MNAF_BMNAFs = batched.accumulate_facet_batch(
-            core, col, off0, stack.offs1, stack.masks1, stack.size,
+            core, col, off0, self._offs1, self._masks1, stack.size,
             self._MNAF_BMNAFs,
         )
         self.queue.admit([self._MNAF_BMNAFs])
@@ -365,10 +425,10 @@ class SwiftlyBackward:
         facets = batched.finish_facets_batch(
             self.core,
             self._MNAF_BMNAFs,
-            self.stack.offs0,
-            self.stack.masks0,
+            self._offs0,
+            self._masks0,
             self.stack.size,
         )
         self.queue.drain()
         self._finished = True
-        return facets
+        return facets[: self.stack.n_real]

@@ -81,6 +81,9 @@ class SwiftlyConfig:
     :param backend: numerical backend — "jax" (complex XLA), "planar"
         (TPU-native real pairs), or "numpy" (host reference)
     :param dtype: forwarded to the core
+    :param mesh: optional jax.sharding.Mesh; when given, the streaming API
+        shards facet stacks over the mesh's first axis and facet-sum
+        reductions become cross-device collectives
     """
 
     def __init__(
@@ -94,8 +97,10 @@ class SwiftlyConfig:
         xM_size: int,
         backend: str = "jax",
         dtype=None,
+        mesh=None,
         **_other,
     ):
+        self.mesh = mesh
         self._W = W
         self._fov = fov
         self._N = N

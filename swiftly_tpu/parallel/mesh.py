@@ -1,0 +1,72 @@
+"""Device-mesh construction and multi-host initialisation.
+
+The execution fabric of the framework: where the reference distributes
+tasks over a Dask scheduler/worker cluster (api.py:133-147), the TPU build
+lays facets out over a `jax.sharding.Mesh` axis and lets XLA insert the
+collectives (psum over ICI within a slice, DCN across slices).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+FACET_AXIS = "facet"
+
+__all__ = [
+    "FACET_AXIS",
+    "facet_sharding",
+    "initialize_multihost",
+    "make_facet_mesh",
+    "pad_to_shards",
+    "replicated_sharding",
+]
+
+
+def make_facet_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1D mesh over the facet stack axis.
+
+    :param n_devices: number of devices to use (default: all available)
+    :param devices: explicit device list (overrides n_devices)
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (FACET_AXIS,))
+
+
+def facet_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits the leading (facet-stack) axis over the mesh."""
+    return NamedSharding(mesh, PartitionSpec(FACET_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding on the mesh."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_shards(n: int, n_shards: int) -> int:
+    """Facet count padded up to a multiple of the mesh size.
+
+    Zero-padded facets contribute zeros to every linear accumulation, so
+    padding is exact (not approximate)."""
+    return ((n + n_shards - 1) // n_shards) * n_shards
+
+
+def initialize_multihost(coordinator=None, num_processes=None, process_id=None):
+    """Initialise JAX distributed runtime for multi-host (pod-slice) runs.
+
+    On TPU pods with standard orchestration all arguments are discovered
+    automatically; arguments are for manual (e.g. GPU/CPU cluster) setups.
+    Safe to call once per process before any device use.
+    """
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,17 @@
+"""Utilities: profiling, memory accounting, logging."""
+
+from .profiling import (
+    MemorySampler,
+    collective_bytes_backward,
+    collective_bytes_forward,
+    device_memory_stats,
+    trace,
+)
+
+__all__ = [
+    "MemorySampler",
+    "collective_bytes_backward",
+    "collective_bytes_forward",
+    "device_memory_stats",
+    "trace",
+]

@@ -1,0 +1,87 @@
+"""Checkpoint/resume for streaming transforms.
+
+A `SwiftlyBackward` session is a long-running accumulation (hours at 64k
+scale); its state is exactly (a) the per-facet accumulators, (b) the live
+per-column accumulators in the LRU, and (c) which subgrids have been
+folded in. This module snapshots that state to a single ``.npz`` so a
+killed run resumes without recomputing finished subgrids.
+
+(The reference has no checkpointing — its docs mention removed HDF5
+subgrid dumps; this implements the "streaming accumulators as checkpoint
+units" design its architecture implies.)
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+__all__ = ["save_backward_state", "restore_backward_state"]
+
+_VERSION = 1
+
+
+def save_backward_state(path, backward, processed_subgrids=None):
+    """Snapshot a SwiftlyBackward session to `path` (.npz).
+
+    :param backward: the SwiftlyBackward instance
+    :param processed_subgrids: optional list of (off0, off1) already folded
+        in, stored for the caller to skip on resume
+    """
+    core = backward.core
+    arrays = {}
+    meta = {
+        "version": _VERSION,
+        "backend": core.backend,
+        "params": [core.W, core.N, core.xM_size, core.yN_size],
+        "n_real": backward.stack.n_real,
+        "n_total": backward.stack.n_total,
+        "lru_keys": [],
+        "processed": list(map(list, processed_subgrids or [])),
+        "has_mnaf": backward._MNAF_BMNAFs is not None,
+    }
+    if backward._MNAF_BMNAFs is not None:
+        arrays["MNAF_BMNAFs"] = np.asarray(backward._MNAF_BMNAFs)
+    for key, col in backward.lru._store.items():
+        meta["lru_keys"].append(int(key))
+        arrays[f"lru_{int(key)}"] = np.asarray(col)
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez(path, **arrays)
+
+
+def restore_backward_state(path, backward):
+    """Restore a snapshot into a freshly constructed SwiftlyBackward.
+
+    The instance must be built with the same config/facet list as the one
+    saved. Returns the list of (off0, off1) subgrids already processed.
+    """
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+        if meta["version"] != _VERSION:
+            raise ValueError(f"Unsupported checkpoint version {meta['version']}")
+        core = backward.core
+        expect = [core.W, core.N, core.xM_size, core.yN_size]
+        if meta["params"] != expect or meta["backend"] != core.backend:
+            raise ValueError(
+                f"Checkpoint was written for params {meta['params']} "
+                f"backend {meta['backend']!r}; this session has {expect} "
+                f"backend {core.backend!r}"
+            )
+        if meta["n_total"] != backward.stack.n_total:
+            raise ValueError("Facet stack size mismatch")
+
+        def _dev(arr):
+            if core.backend == "numpy":
+                return np.array(arr)
+            import jax.numpy as jnp
+
+            return jnp.asarray(arr)
+
+        if meta["has_mnaf"]:
+            backward._MNAF_BMNAFs = _dev(data["MNAF_BMNAFs"])
+        for key in meta["lru_keys"]:
+            backward.lru.set(key, _dev(data[f"lru_{key}"]))
+        return [tuple(p) for p in meta["processed"]]
