@@ -35,6 +35,7 @@ from .models.covers import (
 )
 from .ops.oracle import make_facet_from_sources, make_subgrid_from_sources
 from .parallel import batched
+from .parallel.mesh import pad_to_shards
 
 log = logging.getLogger("swiftly-tpu")
 
@@ -209,8 +210,8 @@ class _FacetStack:
         self.size = sizes.pop()
         self.configs = list(facet_configs)
         self.n_real = len(facet_configs)
-        n_pad = (-self.n_real) % pad_to
-        self.n_total = self.n_real + n_pad
+        self.n_total = pad_to_shards(self.n_real, pad_to)
+        n_pad = self.n_total - self.n_real
 
         def mask_row(mask):
             return np.ones(self.size) if mask is None else np.asarray(mask)

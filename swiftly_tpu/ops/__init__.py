@@ -1,6 +1,11 @@
 """Numerical ops: L0 primitives, PSWF windows, and the SwiftlyCore."""
 
 from .core import SwiftlyCore, validate_core_params
+from .io_slices import (
+    create_slice,
+    roll_and_extract_mid,
+    roll_and_extract_mid_axis,
+)
 from .oracle import (
     generate_masks,
     make_facet_from_sources,
@@ -12,6 +17,9 @@ from .pswf import pswf_fb, pswf_fn, pswf_samples
 __all__ = [
     "SwiftlyCore",
     "validate_core_params",
+    "create_slice",
+    "roll_and_extract_mid",
+    "roll_and_extract_mid_axis",
     "generate_masks",
     "make_facet_from_sources",
     "make_subgrid_from_sources",

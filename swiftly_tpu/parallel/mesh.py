@@ -33,6 +33,11 @@ def make_facet_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"Requested a {n_devices}-device mesh but only "
+                    f"{len(devices)} devices are available"
+                )
             devices = devices[:n_devices]
     return Mesh(np.array(devices), (FACET_AXIS,))
 

@@ -73,12 +73,23 @@ def restore_backward_state(path, backward):
         if meta["n_total"] != backward.stack.n_total:
             raise ValueError("Facet stack size mismatch")
 
+        mesh = getattr(backward, "mesh", None)
+
         def _dev(arr):
             if core.backend == "numpy":
                 return np.array(arr)
+            import jax
             import jax.numpy as jnp
 
-            return jnp.asarray(arr)
+            arr = jnp.asarray(arr)
+            if mesh is not None:
+                # Restore the facet-sharded layout the accumulators were
+                # created with (api._place); without this a mesh session
+                # resumes with everything on one device.
+                from ..parallel.mesh import facet_sharding
+
+                arr = jax.device_put(arr, facet_sharding(mesh))
+            return arr
 
         if meta["has_mnaf"]:
             backward._MNAF_BMNAFs = _dev(data["MNAF_BMNAFs"])

@@ -93,6 +93,7 @@ class MemorySampler:
 
     @contextlib.contextmanager
     def sample(self):
+        self.rows = []
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -115,22 +116,19 @@ def _itemsize(dtype, planar: bool) -> int:
 
 
 def collective_bytes_forward(
-    n_facets: int, xM_yN_size: int, xM_size: int, n_devices: int,
-    dtype=np.float32, planar: bool = True,
+    xM_size: int, n_devices: int, dtype=np.float32, planar: bool = True,
 ) -> int:
     """Bytes crossing the mesh per forward subgrid (analytic).
 
-    Each device contributes a partial padded subgrid [xM, xM]; the
-    all-reduce over d devices moves ~2*(d-1)/d of the buffer per device
-    (ring all-reduce cost).
+    Each device contributes a partial padded subgrid [xM, xM]; a ring
+    all-reduce over d devices moves 2*(d-1) buffers in total.
     """
     buf = xM_size * xM_size * _itemsize(dtype, planar)
-    return int(buf * 2 * (n_devices - 1) / max(n_devices, 1) * n_devices)
+    return int(buf * 2 * (n_devices - 1))
 
 
 def collective_bytes_backward(
-    n_facets: int, xM_yN_size: int, xA_size: int, n_devices: int,
-    dtype=np.float32, planar: bool = True,
+    xA_size: int, n_devices: int, dtype=np.float32, planar: bool = True,
 ) -> int:
     """Bytes crossing the mesh per backward subgrid (analytic).
 

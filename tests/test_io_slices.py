@@ -1,0 +1,72 @@
+"""Wrap-around IO slicing: slices must equal a literal roll + centre-extract.
+
+Mirrors the reference tier-1 strategy
+(tests/test_fourier_algorithm.py:499-584): every slice decomposition is
+checked against the materialised ``np.roll`` it replaces, over offsets that
+exercise no-wrap, left-wrap, right-wrap, and full-revolution cases, for even
+and odd window sizes.
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.ops import (
+    create_slice,
+    roll_and_extract_mid,
+    roll_and_extract_mid_axis,
+)
+
+
+def _oracle_1d(data, offset, window):
+    rolled = np.roll(data, -offset)
+    start = len(data) // 2 - window // 2
+    return rolled[start : start + window]
+
+
+@pytest.mark.parametrize("size", [16, 17, 100])
+@pytest.mark.parametrize("window", [4, 5, 15])
+@pytest.mark.parametrize(
+    "offset", [0, 1, -1, 3, -7, 8, -8, 50, -50, 99, 200, -200]
+)
+def test_roll_and_extract_mid_matches_roll(size, window, offset):
+    data = np.arange(size) * 1.0
+    slices = roll_and_extract_mid(size, offset, window)
+    got = np.concatenate([data[sl] for sl in slices])
+    np.testing.assert_array_equal(got, _oracle_1d(data, offset, window))
+
+
+def test_roll_and_extract_mid_is_at_most_two_slices():
+    for offset in range(-40, 40):
+        slices = roll_and_extract_mid(20, offset, 12)
+        assert 1 <= len(slices) <= 2
+        assert sum(sl.stop - sl.start for sl in slices) == 12
+        for sl in slices:
+            assert 0 <= sl.start < sl.stop <= 20
+
+
+def test_roll_and_extract_mid_window_too_large():
+    with pytest.raises(ValueError):
+        roll_and_extract_mid(8, 0, 9)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("offset", [0, 5, -5, 13, -27, 64])
+@pytest.mark.parametrize("window", [6, 7])
+def test_roll_and_extract_mid_axis_2d(axis, offset, window):
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(24, 18)) + 1j * rng.normal(size=(24, 18))
+    got = roll_and_extract_mid_axis(data, offset, window, axis)
+    want_rolled = np.roll(data, -offset, axis=axis)
+    start = data.shape[axis] // 2 - window // 2
+    sl = create_slice(slice(None), slice(start, start + window), 2, axis)
+    np.testing.assert_array_equal(got, want_rolled[sl])
+    assert got.dtype == data.dtype
+
+
+def test_create_slice():
+    assert create_slice(slice(None), 3, 3, 1) == (slice(None), 3, slice(None))
+    assert create_slice(0, slice(1, 2), 2, 0) == (slice(1, 2), 0)
+    with pytest.raises(ValueError):
+        create_slice(0, 0, 2.5, 0)
+    with pytest.raises(ValueError):
+        create_slice(0, 0, 2, None)

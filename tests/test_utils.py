@@ -118,8 +118,8 @@ def test_memory_sampler(tmp_path):
 
 def test_collective_bytes_analytic():
     # single device: no cross-device traffic forward
-    assert collective_bytes_forward(9, 128, 256, 1) == 0
-    fwd8 = collective_bytes_forward(9, 128, 256, 8)
-    assert fwd8 > 0
-    bwd8 = collective_bytes_backward(9, 128, 228, 8)
+    assert collective_bytes_forward(256, 1) == 0
+    fwd8 = collective_bytes_forward(256, 8)
+    assert fwd8 == 256 * 256 * 8 * 2 * 7  # ring all-reduce: 2*(d-1) buffers
+    bwd8 = collective_bytes_backward(228, 8)
     assert bwd8 == 228 * 228 * 8 * 7  # planar f32 = 8 B/px, 7 receivers
