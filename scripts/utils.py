@@ -37,7 +37,7 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
         "--backend",
         type=str,
         default="jax",
-        choices=["jax", "planar", "numpy"],
+        choices=["jax", "planar", "numpy", "native"],
         help="numerical backend",
     )
     parser.add_argument(

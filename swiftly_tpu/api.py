@@ -366,7 +366,7 @@ class SwiftlyBackward:
 
     def _zeros(self, shape):
         core = self.core
-        if core.backend == "numpy":
+        if core.backend in ("numpy", "native"):
             return np.zeros(shape, dtype=complex)
         import jax.numpy as jnp
 

@@ -100,6 +100,11 @@ class SwiftlyConfig:
         mesh=None,
         **_other,
     ):
+        if mesh is not None and backend in ("numpy", "native"):
+            raise ValueError(
+                f"backend={backend!r} runs on the host; a device mesh "
+                "requires the 'jax' or 'planar' backend"
+            )
         self.mesh = mesh
         self._W = W
         self._fov = fov

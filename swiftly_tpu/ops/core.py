@@ -193,11 +193,15 @@ class SwiftlyCore:
 
     Holds the configuration (W, N, xM_size, yN_size), precomputes the PSWF
     window constants, and exposes the eight per-axis primitives for both
-    directions. Two backends share one math implementation:
+    directions. Four backends, one behavioural contract:
 
-    * ``backend="jax"`` — jit-compiled XLA programs (TPU/CPU); offsets are
-      traced, so each primitive compiles once per array shape.
-    * ``backend="numpy"`` — eager float64 host execution.
+    * ``backend="jax"`` — jit-compiled XLA programs (complex dtypes);
+      offsets are traced, so each primitive compiles once per array shape.
+    * ``backend="planar"`` — TPU-native: complex data as (..., 2) real
+      pairs, FFTs as MXU matmuls (for TPUs without complex/FFT support).
+    * ``backend="numpy"`` — eager float64 host reference.
+    * ``backend="native"`` — compiled C++ host kernels (swiftly_tpu.native),
+      the role the ska-sdp-func C library plays for the reference.
 
     :param W: PSWF grid-space support parameter
     :param N: total (virtual) image size
@@ -225,6 +229,16 @@ class SwiftlyCore:
             self._p = npk
             self._Fb = fb
             self._Fn = fn
+        elif backend == "native":
+            # Compiled C++ host kernels (see swiftly_tpu/native) — the
+            # role the external ska-sdp-func C library plays for the
+            # reference (core.py:487-929).
+            from ..native import NativeKernels
+
+            self._p = npk
+            self._Fb = fb
+            self._Fn = fn
+            self._native = NativeKernels(N, xM_size, yN_size, fb, fn)
         elif backend == "jax":
             self._p = jxk
             if dtype is None:
@@ -301,6 +315,10 @@ class SwiftlyCore:
         """Run `fn(p, *bound, *args)`; jitted & cached for the JAX backend."""
         if self.backend == "numpy":
             return fn(*args)
+        if self.backend == "native":
+            raise AssertionError(
+                "native backend must dispatch before _run"
+            )  # pragma: no cover
         key = (name, static)
         jitted = self._jit_cache.get(key)
         if jitted is None:
@@ -309,7 +327,7 @@ class SwiftlyCore:
         return jitted(*args)
 
     def _prep(self, a):
-        if self.backend == "numpy":
+        if self.backend in ("numpy", "native"):
             return np.asarray(a, dtype=complex)
         if self.backend == "planar":
             if not np.iscomplexobj(a) and a.shape and a.shape[-1] == 2:
@@ -340,6 +358,10 @@ class SwiftlyCore:
         Expensive (full-size iFFT); intended to be done once per facet and
         reused for every subgrid.
         """
+        if self.backend == "native":
+            return _apply_out(
+                self._native.prepare_facet(facet, facet_off, axis), out
+            )
         fn = functools.partial(
             prepare_facet_math, self._p, self._Fb, self.yN_size, axis=axis
         )
@@ -347,6 +369,11 @@ class SwiftlyCore:
 
     def extract_from_facet(self, prep_facet, subgrid_off, axis, out=None):
         """Extract a facet's compact contribution to one subgrid (per axis)."""
+        if self.backend == "native":
+            return _apply_out(
+                self._native.extract_from_facet(prep_facet, subgrid_off, axis),
+                out,
+            )
         fn = functools.partial(
             extract_from_facet_math,
             self._p,
@@ -363,6 +390,11 @@ class SwiftlyCore:
         Returns the summand; with ``out`` given, adds into/onto it
         (reference add-semantics, ``core.py:285``).
         """
+        if self.backend == "native":
+            # Native kernels accumulate into `out` in place themselves.
+            return self._native.add_to_subgrid(
+                facet_contrib, facet_off, axis, out=out
+            )
         fn = functools.partial(
             add_to_subgrid_math, self._p, self._Fn, self.xM_size, self.N, axis=axis
         )
@@ -376,6 +408,10 @@ class SwiftlyCore:
         """Finish a subgrid from summed contributions (all axes at once)."""
         data = self._prep(summed_contribs)
         offs = self._as_offsets(subgrid_off, self._p.ndim(data))
+        if self.backend == "native":
+            return _apply_out(
+                self._native.finish_subgrid(data, offs, subgrid_size), out
+            )
         fn = functools.partial(finish_subgrid_math, self._p, subgrid_size)
         return _apply_out(
             self._run("fs", fn, data, offs, static=(subgrid_size,)),
@@ -388,11 +424,20 @@ class SwiftlyCore:
         """Embed + FFT a subgrid into image space (all axes at once)."""
         data = self._prep(subgrid)
         offs = self._as_offsets(subgrid_off, self._p.ndim(data))
+        if self.backend == "native":
+            return _apply_out(self._native.prepare_subgrid(data, offs), out)
         fn = functools.partial(prepare_subgrid_math, self._p, self.xM_size)
         return _apply_out(self._run("ps", fn, data, offs), out)
 
     def extract_from_subgrid(self, prep_subgrid, facet_off, axis, out=None):
         """Extract a subgrid's windowed contribution to one facet (per axis)."""
+        if self.backend == "native":
+            return _apply_out(
+                self._native.extract_from_subgrid(
+                    prep_subgrid, facet_off, axis
+                ),
+                out,
+            )
         fn = functools.partial(
             extract_from_subgrid_math,
             self._p,
@@ -409,6 +454,10 @@ class SwiftlyCore:
 
         Returns the summand; with ``out`` given, adds into/onto it.
         """
+        if self.backend == "native":
+            return self._native.add_to_facet(
+                subgrid_contrib, subgrid_off, axis, out=out
+            )
         fn = functools.partial(
             add_to_facet_math, self._p, self.yN_size, self.N, axis=axis
         )
@@ -420,6 +469,11 @@ class SwiftlyCore:
 
     def finish_facet(self, summed, facet_off, facet_size, axis, out=None):
         """Finish a facet from summed subgrid contributions (per axis)."""
+        if self.backend == "native":
+            return _apply_out(
+                self._native.finish_facet(summed, facet_off, facet_size, axis),
+                out,
+            )
         fn = functools.partial(
             finish_facet_math, self._p, self._Fb, facet_size, axis=axis
         )

@@ -53,8 +53,10 @@ __all__ = [
 ]
 
 
-def _is_np(core):
-    return core.backend == "numpy"
+def _is_host(core):
+    """Host-eager backends: loop over the stack calling the core's
+    dispatching methods (plain numpy, or the compiled native kernels)."""
+    return core.backend in ("numpy", "native")
 
 
 def _mask_along(p, data, mask, axis):
@@ -79,14 +81,9 @@ def prepare_facets_batch(core, facets, offs0):
     Done once per streaming session and reused for every subgrid
     (reference `_get_BF_Fs`, api.py:281-298).
     """
-    if _is_np(core):
+    if _is_host(core):
         return np.stack(
-            [
-                prepare_facet_math(
-                    core._p, core._Fb, core.yN_size, f, int(o), 0
-                )
-                for f, o in zip(facets, offs0)
-            ]
+            [core.prepare_facet(f, int(o), 0) for f, o in zip(facets, offs0)]
         )
     return _prepare_facets_j(core, core._prep(facets), jnp.asarray(offs0))
 
@@ -111,18 +108,11 @@ def extract_columns_batch(core, BF_Fs, off0, offs1):
     by every subgrid with this off0 (reference `extract_column`,
     api_helper.py:200-210).
     """
-    if _is_np(core):
+    if _is_host(core):
         out = []
         for BF_F, off1 in zip(BF_Fs, offs1):
-            col = extract_from_facet_math(
-                core._p, core.xM_yN_size, core.N, core.yN_size,
-                BF_F, int(off0), 0,
-            )
-            out.append(
-                prepare_facet_math(
-                    core._p, core._Fb, core.yN_size, col, int(off1), 1
-                )
-            )
+            col = core.extract_from_facet(BF_F, int(off0), 0)
+            out.append(core.prepare_facet(col, int(off1), 1))
         return np.stack(out)
     return _extract_columns_j(
         core, BF_Fs, jnp.asarray(off0), jnp.asarray(offs1)
@@ -162,23 +152,16 @@ def subgrid_from_columns_batch(
     finishes, and applies ownership masks (reference
     `sum_and_finish_subgrid`, api_helper.py:73-112).
     """
-    if _is_np(core):
+    if _is_host(core):
         p = core._p
         summed = None
         for NMBF_BF, foff0, foff1 in zip(NMBF_BFs, offs0, offs1):
-            NMBF_NMBF = extract_from_facet_math(
-                p, core.xM_yN_size, core.N, core.yN_size,
-                NMBF_BF, int(sg_off1), 1,
-            )
-            acc = add_to_subgrid_math(
-                p, core._Fn, core.xM_size, core.N, NMBF_NMBF, int(foff0), 0
-            )
-            acc = add_to_subgrid_math(
-                p, core._Fn, core.xM_size, core.N, acc, int(foff1), 1
-            )
+            NMBF_NMBF = core.extract_from_facet(NMBF_BF, int(sg_off1), 1)
+            acc = core.add_to_subgrid(NMBF_NMBF, int(foff0), 0)
+            acc = core.add_to_subgrid(acc, int(foff1), 1)
             summed = acc if summed is None else summed + acc
-        subgrid = finish_subgrid_math(
-            p, subgrid_size, summed, [int(sg_off0), int(sg_off1)]
+        subgrid = core.finish_subgrid(
+            summed, [int(sg_off0), int(sg_off1)], subgrid_size
         )
         subgrid = _mask_along(p, subgrid, masks[0], 0)
         return _mask_along(p, subgrid, masks[1], 1)
@@ -220,24 +203,14 @@ def split_subgrid_batch(core, subgrid, sg_off0, sg_off1, offs0, offs1):
 
     (Reference `prepare_and_split_subgrid`, api_helper.py:115-139.)
     """
-    if _is_np(core):
-        p = core._p
-        prepped = prepare_subgrid_math(
-            p, core.xM_size, np.asarray(subgrid, dtype=complex),
-            [int(sg_off0), int(sg_off1)],
+    if _is_host(core):
+        prepped = core.prepare_subgrid(
+            np.asarray(subgrid, dtype=complex), [int(sg_off0), int(sg_off1)]
         )
         out = []
         for foff0, foff1 in zip(offs0, offs1):
-            e0 = extract_from_subgrid_math(
-                p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
-                prepped, int(foff0), 0,
-            )
-            out.append(
-                extract_from_subgrid_math(
-                    p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
-                    e0, int(foff1), 1,
-                )
-            )
+            e0 = core.extract_from_subgrid(prepped, int(foff0), 0)
+            out.append(core.extract_from_subgrid(e0, int(foff1), 1))
         return np.stack(out)
     return _split_subgrid_j(
         core,
@@ -258,11 +231,9 @@ def accumulate_column_batch(core, NAF_NAFs, sg_off1, NAF_MNAFs):
     """Fold one subgrid's NAF_NAFs [F, m, m] into the column accumulator
     NAF_MNAFs [F, m, yN] (reference `accumulate_column`,
     api_helper.py:142-152)."""
-    if _is_np(core):
+    if _is_host(core):
         for i, c in enumerate(NAF_NAFs):
-            NAF_MNAFs[i] += add_to_facet_math(
-                core._p, core.yN_size, core.N, c, int(sg_off1), 1
-            )
+            core.add_to_facet(c, int(sg_off1), 1, out=NAF_MNAFs[i])
         return NAF_MNAFs
     return _accumulate_column_j(
         core, NAF_NAFs, jnp.asarray(sg_off1), NAF_MNAFs
@@ -292,18 +263,16 @@ def accumulate_facet_batch(
     Axis-1 finish + mask, then axis-0 embed at the column's sg_off0
     (reference `accumulate_facet`, api_helper.py:155-179).
     """
-    if _is_np(core):
+    if _is_host(core):
         p = core._p
         for i, (NAF_MNAF, off1, mask1) in enumerate(
             zip(NAF_MNAFs, offs1, masks1)
         ):
-            NAF_BMNAF = finish_facet_math(
-                p, core._Fb, facet_size, NAF_MNAF, int(off1), 1
+            NAF_BMNAF = core.finish_facet(NAF_MNAF, int(off1), facet_size, 1)
+            NAF_BMNAF = np.ascontiguousarray(
+                _mask_along(p, NAF_BMNAF, np.asarray(mask1), 1)
             )
-            NAF_BMNAF = _mask_along(p, NAF_BMNAF, np.asarray(mask1), 1)
-            MNAF_BMNAFs[i] += add_to_facet_math(
-                p, core.yN_size, core.N, NAF_BMNAF, int(sg_off0), 0
-            )
+            core.add_to_facet(NAF_BMNAF, int(sg_off0), 0, out=MNAF_BMNAFs[i])
         return MNAF_BMNAFs
     return _accumulate_facet_j(
         core,
@@ -332,13 +301,11 @@ def _finish_facets_j(core, MNAF_BMNAFs, offs0, masks0, facet_size):
 def finish_facets_batch(core, MNAF_BMNAFs, offs0, masks0, facet_size):
     """MNAF_BMNAFs [F, yN, yB] -> finished facets [F, yB, yB]
     (reference `finish_facet` wrapper, api_helper.py:182-197)."""
-    if _is_np(core):
+    if _is_host(core):
         p = core._p
         out = []
         for MNAF_BMNAF, off0, mask0 in zip(MNAF_BMNAFs, offs0, masks0):
-            facet = finish_facet_math(
-                p, core._Fb, facet_size, MNAF_BMNAF, int(off0), 0
-            )
+            facet = core.finish_facet(MNAF_BMNAF, int(off0), facet_size, 0)
             out.append(_mask_along(p, facet, np.asarray(mask0), 0))
         return np.stack(out)
     return _finish_facets_j(

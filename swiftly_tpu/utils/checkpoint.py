@@ -76,7 +76,7 @@ def restore_backward_state(path, backward):
         mesh = getattr(backward, "mesh", None)
 
         def _dev(arr):
-            if core.backend == "numpy":
+            if core.backend in ("numpy", "native"):
                 return np.array(arr)
             import jax
             import jax.numpy as jnp

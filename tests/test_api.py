@@ -99,6 +99,18 @@ def test_roundtrip_numpy():
     assert max(facet_errors) < 3e-10
 
 
+def test_roundtrip_native():
+    """The compiled C++ kernels drive the full streaming API."""
+    pytest.importorskip("swiftly_tpu.native")
+    from swiftly_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    sg_errors, facet_errors = roundtrip("native", 100, 2, 2, True)
+    assert max(sg_errors) < 3e-10
+    assert max(facet_errors) < 3e-10
+
+
 def test_roundtrip_planar_f64():
     sg_errors, facet_errors = roundtrip(
         "planar", 100, 1, 1, True, dtype=np.float64

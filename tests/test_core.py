@@ -27,7 +27,11 @@ PARAMS = {
     "xM_size": 256,
 }
 
+from swiftly_tpu.native import native_available
+
 BACKENDS = ["numpy", "jax"]
+if native_available():
+    BACKENDS.append("native")
 
 
 def make_core(backend, pars=PARAMS):

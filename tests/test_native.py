@@ -1,0 +1,115 @@
+"""Native C++ backend specifics not covered by the shared core tests.
+
+The shared behavioural suite (test_core.py) runs every primitive over the
+native backend; this file covers what is unique to the compiled path:
+non-power-of-two FFT sizes (Bluestein), the fused 2D fast path, accumulate
+(out=) semantics, pickling-by-params, and error handling.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.native import NativeKernels, native_available
+from swiftly_tpu.ops import SwiftlyCore, make_facet_from_sources
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+SOURCES = [(1.0, 40, -30), (0.5, -100, 7)]
+
+
+def _cores(params):
+    W, N, xM, yN = params
+    return (
+        SwiftlyCore(W, N, xM, yN, backend="numpy"),
+        SwiftlyCore(W, N, xM, yN, backend="native"),
+    )
+
+
+@pytest.mark.parametrize(
+    "params",
+    [
+        (13.5625, 1024, 256, 512),  # power-of-two sizes
+        (10.75, 1536, 384, 768),    # 3*2^k sizes -> Bluestein FFT
+    ],
+)
+def test_native_matches_numpy_full_chain(params):
+    cn, cc = _cores(params)
+    N = cn.N
+    yB = 13 * cn.yN_size // 16
+    xA = cn.xM_size - 28
+    facet = make_facet_from_sources(SOURCES, N, yB, [0, 0])
+    results = []
+    for core in (cn, cc):
+        p = core.prepare_facet(core.prepare_facet(facet, 0, 0), 0, 1)
+        c = core.extract_from_facet(
+            core.extract_from_facet(p, core.xM_size, 0), 0, 1
+        )
+        a = core.add_to_subgrid(core.add_to_subgrid(c, 0, 0), 0, 1)
+        results.append(np.asarray(core.finish_subgrid(a, [core.xM_size, 0], xA)))
+    np.testing.assert_allclose(results[0], results[1], atol=1e-11)
+
+
+def test_native_fused_2d_matches_per_axis():
+    _, cc = _cores((13.5625, 1024, 256, 512))
+    rng = np.random.default_rng(0)
+    m = cc.xM_yN_size
+    contrib = rng.normal(size=(m, m)) + 1j * rng.normal(size=(m, m))
+    per_axis = cc.add_to_subgrid(cc.add_to_subgrid(contrib, 256, 0), 512, 1)
+    fused = cc._native.add_to_subgrid_2d(contrib, (256, 512))
+    np.testing.assert_allclose(np.asarray(per_axis), fused, atol=1e-13)
+
+
+def test_native_accumulates_into_out():
+    _, cc = _cores((13.5625, 1024, 256, 512))
+    rng = np.random.default_rng(1)
+    m = cc.xM_yN_size
+    c1 = rng.normal(size=m) + 1j * rng.normal(size=m)
+    c2 = rng.normal(size=m) + 1j * rng.normal(size=m)
+    acc = np.zeros(cc.xM_size, dtype=complex)
+    cc.add_to_subgrid(c1, 0, 0, out=acc)
+    cc.add_to_subgrid(c2, 256, 0, out=acc)
+    expect = np.asarray(cc.add_to_subgrid(c1, 0, 0)) + np.asarray(
+        cc.add_to_subgrid(c2, 256, 0)
+    )
+    np.testing.assert_allclose(acc, expect, atol=1e-13)
+
+
+def test_native_negative_offsets_match_numpy():
+    cn, cc = _cores((13.5625, 1024, 256, 512))
+    rng = np.random.default_rng(2)
+    m = cn.xM_yN_size
+    contrib = rng.normal(size=m) + 1j * rng.normal(size=m)
+    a_np = np.asarray(cn.add_to_subgrid(contrib, -256, 0))
+    a_cc = np.asarray(cc.add_to_subgrid(contrib, -256, 0))
+    np.testing.assert_allclose(a_np, a_cc, atol=1e-13)
+
+
+def test_native_pickles_by_params():
+    _, cc = _cores((13.5625, 1024, 256, 512))
+    clone = pickle.loads(pickle.dumps(cc._native))
+    rng = np.random.default_rng(3)
+    facet = rng.normal(size=416) + 1j * rng.normal(size=416)
+    np.testing.assert_array_equal(
+        np.asarray(cc._native.prepare_facet(facet, 0, 0)),
+        np.asarray(clone.prepare_facet(facet, 0, 0)),
+    )
+
+
+def test_native_rejects_bad_params():
+    with pytest.raises(ValueError):
+        NativeKernels(1000, 256, 512, np.ones(511), np.ones(128))
+
+
+def test_native_rejects_bad_out_shape():
+    _, cc = _cores((13.5625, 1024, 256, 512))
+    with pytest.raises(ValueError):
+        cc.add_to_subgrid(
+            np.zeros(cc.xM_yN_size, dtype=complex),
+            0,
+            0,
+            out=np.zeros(7, dtype=complex),
+        )
