@@ -34,7 +34,7 @@ from .models.covers import (
     sparse_fov_cover_offsets,
 )
 from .ops.oracle import make_facet_from_sources, make_subgrid_from_sources
-from .parallel import batched
+from .parallel import batched, sharded
 from .parallel.mesh import pad_to_shards
 
 log = logging.getLogger("swiftly-tpu")
@@ -258,6 +258,10 @@ def _place(core, mesh, arr, shard_facets: bool):
     return jax.device_put(arr, sharding)
 
 
+def _use_shard_map(config):
+    return getattr(config, "spmd_mode", "shard_map") == "shard_map"
+
+
 def _subgrid_masks(sg_config):
     size = sg_config.size
     m0 = np.ones(size) if sg_config.mask0 is None else np.asarray(sg_config.mask0)
@@ -319,16 +323,29 @@ class SwiftlyForward:
     def get_subgrid_task(self, subgrid_config):
         """Compute one subgrid (asynchronous device array)."""
         cols = self._get_columns(subgrid_config.off0)
-        subgrid = batched.subgrid_from_columns_batch(
-            self.core,
-            cols,
-            self._offs0,
-            self._offs1,
-            subgrid_config.off0,
-            subgrid_config.off1,
-            subgrid_config.size,
-            _subgrid_masks(subgrid_config),
-        )
+        if self.mesh is not None and _use_shard_map(self.config):
+            subgrid = sharded.subgrid_from_columns_sharded(
+                self.core,
+                self.mesh,
+                cols,
+                self._offs0,
+                self._offs1,
+                subgrid_config.off0,
+                subgrid_config.off1,
+                subgrid_config.size,
+                _subgrid_masks(subgrid_config),
+            )
+        else:
+            subgrid = batched.subgrid_from_columns_batch(
+                self.core,
+                cols,
+                self._offs0,
+                self._offs1,
+                subgrid_config.off0,
+                subgrid_config.off1,
+                subgrid_config.size,
+                _subgrid_masks(subgrid_config),
+            )
         self.queue.admit([subgrid])
         return subgrid
 
@@ -385,9 +402,15 @@ class SwiftlyBackward:
         core, stack = self.core, self.stack
         off0, off1 = subgrid_config.off0, subgrid_config.off1
 
-        NAF_NAFs = batched.split_subgrid_batch(
-            core, subgrid_data, off0, off1, self._offs0, self._offs1
-        )
+        if self.mesh is not None and _use_shard_map(self.config):
+            NAF_NAFs = sharded.split_subgrid_sharded(
+                core, self.mesh, subgrid_data, off0, off1,
+                self._offs0, self._offs1,
+            )
+        else:
+            NAF_NAFs = batched.split_subgrid_batch(
+                core, subgrid_data, off0, off1, self._offs0, self._offs1
+            )
 
         col = self.lru.get(off0)
         if col is None:

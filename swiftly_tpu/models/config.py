@@ -84,6 +84,9 @@ class SwiftlyConfig:
     :param mesh: optional jax.sharding.Mesh; when given, the streaming API
         shards facet stacks over the mesh's first axis and facet-sum
         reductions become cross-device collectives
+    :param spmd_mode: how mesh collectives are expressed — "shard_map"
+        (explicit jax.shard_map + lax.psum, the default) or "gspmd"
+        (sharded inputs into jit; XLA infers the collectives)
     """
 
     def __init__(
@@ -98,6 +101,7 @@ class SwiftlyConfig:
         backend: str = "jax",
         dtype=None,
         mesh=None,
+        spmd_mode: str = "shard_map",
         **_other,
     ):
         if mesh is not None and backend in ("numpy", "native"):
@@ -105,7 +109,10 @@ class SwiftlyConfig:
                 f"backend={backend!r} runs on the host; a device mesh "
                 "requires the 'jax' or 'planar' backend"
             )
+        if spmd_mode not in ("shard_map", "gspmd"):
+            raise ValueError(f"Unknown spmd_mode: {spmd_mode!r}")
         self.mesh = mesh
+        self.spmd_mode = spmd_mode
         self._W = W
         self._fov = fov
         self._N = N

@@ -45,13 +45,16 @@ def _build_library() -> Path:
         str(_SRC), "-o", tmp,
     ]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except subprocess.CalledProcessError as err:
-        os.unlink(tmp)
-        raise RuntimeError(
-            f"Native backend build failed:\n{err.stderr}"
-        ) from err
-    os.replace(tmp, out)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as err:
+            raise RuntimeError(
+                f"Native backend build failed:\n{err.stderr}"
+            ) from err
+        os.replace(tmp, out)
+    finally:
+        if os.path.exists(tmp):  # compile failed or g++ missing
+            os.unlink(tmp)
     return out
 
 
@@ -187,9 +190,18 @@ class NativeKernels:
 
     # -- facet -> subgrid ---------------------------------------------------
 
+    def _check_facet_size(self, n):
+        # Fb has yN-1 samples; the kernels index Fb[(yN-1)//2 - n//2 + j]
+        # for j < n, so any facet larger than yN-1 would read out of bounds.
+        if n > self.yN_size - 1:
+            raise ValueError(
+                f"Facet size {n} exceeds Fb support {self.yN_size - 1}"
+            )
+
     def prepare_facet(self, facet, facet_off, axis):
         facet = self._prep(facet)
         nF = facet.shape[axis]
+        self._check_facet_size(nF)
         pre, post = self._lanes(facet.shape, axis)
         res = self._out(facet.shape, axis, self.yN_size, None, False)
         self._lib.sw_prepare_facet(
@@ -216,8 +228,8 @@ class NativeKernels:
         m = self.xM_yN_size
         if contrib.shape != (m, m):
             raise ValueError(f"Contribution must be [{m}, {m}]")
-        if out is None:
-            out = np.zeros((self.xM_size, self.xM_size), dtype=complex)
+        out = self._out((self.xM_size, self.xM_size), 0, self.xM_size,
+                        out, True)
         self._lib.sw_add_to_subgrid_2d(
             self._handle, _cbuf(contrib), _cbuf(out),
             int(facet_offs[0]), int(facet_offs[1]),
@@ -258,6 +270,7 @@ class NativeKernels:
         )
 
     def finish_facet(self, summed, facet_off, facet_size, axis):
+        self._check_facet_size(facet_size)
         return self._axis_op(
             self._lib.sw_finish_facet_axis, summed, axis, facet_size,
             extra=(facet_off, facet_size),

@@ -1,5 +1,5 @@
 """Parallel execution: batched kernels, device meshes, sharded pipelines."""
 
-from . import batched
+from . import batched, sharded
 
-__all__ = ["batched"]
+__all__ = ["batched", "sharded"]

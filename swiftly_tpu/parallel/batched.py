@@ -64,6 +64,45 @@ def _mask_along(p, data, mask, axis):
     return data * p.broadcast_along(mask, p.ndim(data), axis)
 
 
+def facet_contrib_to_subgrid(core, NMBF_BF, foff0, foff1, sg_off1):
+    """One facet's column block -> its padded-subgrid summand [xM, xM].
+
+    The per-facet body of the forward hot loop, shared by the single-device
+    vmap reduction and the shard_map+psum path (so the two spmd modes can
+    never diverge numerically)."""
+    p = core._p
+    NMBF_NMBF = extract_from_facet_math(
+        p, core.xM_yN_size, core.N, core.yN_size, NMBF_BF, sg_off1, 1
+    )
+    acc0 = add_to_subgrid_math(
+        p, core._Fn, core.xM_size, core.N, NMBF_NMBF, foff0, 0
+    )
+    return add_to_subgrid_math(
+        p, core._Fn, core.xM_size, core.N, acc0, foff1, 1
+    )
+
+
+def subgrid_contrib_to_facet(core, prepped, foff0, foff1):
+    """A prepared subgrid -> one facet's contribution block [m, m].
+
+    The per-facet body of the backward split, shared by both spmd modes."""
+    p = core._p
+    e0 = extract_from_subgrid_math(
+        p, core._Fn, core.xM_yN_size, core.xM_size, core.N, prepped, foff0, 0
+    )
+    return extract_from_subgrid_math(
+        p, core._Fn, core.xM_yN_size, core.xM_size, core.N, e0, foff1, 1
+    )
+
+
+def finish_masked_subgrid(core, summed, sg_offs, subgrid_size, mask0, mask1):
+    """Finish a summed padded subgrid and apply ownership masks."""
+    p = core._p
+    subgrid = finish_subgrid_math(p, subgrid_size, summed, sg_offs)
+    subgrid = _mask_along(p, subgrid, mask0, 0)
+    return _mask_along(p, subgrid, mask1, 1)
+
+
 # -- facet -> subgrid -------------------------------------------------------
 
 
@@ -123,23 +162,13 @@ def extract_columns_batch(core, BF_Fs, off0, offs1):
 def _subgrid_from_columns_j(
     core, NMBF_BFs, offs0, offs1, sg_offs, masks, subgrid_size
 ):
-    p = core._p
-
-    def contrib(NMBF_BF, foff0, foff1):
-        NMBF_NMBF = extract_from_facet_math(
-            p, core.xM_yN_size, core.N, core.yN_size, NMBF_BF, sg_offs[1], 1
-        )
-        acc0 = add_to_subgrid_math(
-            p, core._Fn, core.xM_size, core.N, NMBF_NMBF, foff0, 0
-        )
-        return add_to_subgrid_math(
-            p, core._Fn, core.xM_size, core.N, acc0, foff1, 1
-        )
-
+    contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
+        core, NMBF_BF, foff0, foff1, sg_offs[1]
+    )
     summed = jnp.sum(jax.vmap(contrib)(NMBF_BFs, offs0, offs1), axis=0)
-    subgrid = finish_subgrid_math(p, subgrid_size, summed, sg_offs)
-    subgrid = _mask_along(p, subgrid, masks[0], 0)
-    return _mask_along(p, subgrid, masks[1], 1)
+    return finish_masked_subgrid(
+        core, summed, sg_offs, subgrid_size, masks[0], masks[1]
+    )
 
 
 def subgrid_from_columns_batch(
@@ -182,19 +211,10 @@ def subgrid_from_columns_batch(
 
 @functools.partial(jax.jit, static_argnums=0)
 def _split_subgrid_j(core, subgrid, sg_offs, offs0, offs1):
-    p = core._p
-    prepped = prepare_subgrid_math(p, core.xM_size, subgrid, sg_offs)
-
-    def extract(foff0, foff1):
-        e0 = extract_from_subgrid_math(
-            p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
-            prepped, foff0, 0,
-        )
-        return extract_from_subgrid_math(
-            p, core._Fn, core.xM_yN_size, core.xM_size, core.N,
-            e0, foff1, 1,
-        )
-
+    prepped = prepare_subgrid_math(core._p, core.xM_size, subgrid, sg_offs)
+    extract = lambda foff0, foff1: subgrid_contrib_to_facet(
+        core, prepped, foff0, foff1
+    )
     return jax.vmap(extract)(offs0, offs1)
 
 

@@ -81,12 +81,13 @@ def test_pad_to_shards():
     assert pad_to_shards(1, 8) == 8
 
 
+@pytest.mark.parametrize("spmd_mode", ["shard_map", "gspmd"])
 @pytest.mark.parametrize("backend", ["jax", "planar"])
-def test_sharded_roundtrip_accuracy(backend):
+def test_sharded_roundtrip_accuracy(backend, spmd_mode):
     mesh = make_facet_mesh()
     dtype = np.float64 if backend == "planar" else None
     config = SwiftlyConfig(backend=backend, mesh=mesh, dtype=dtype,
-                           **TEST_PARAMS)
+                           spmd_mode=spmd_mode, **TEST_PARAMS)
     sg_err, f_err, fwd, _ = _roundtrip(config)
     assert max(sg_err) < 3e-10
     assert max(f_err) < 3e-10
@@ -96,15 +97,40 @@ def test_sharded_roundtrip_accuracy(backend):
     assert len(BF_Fs.sharding.device_set) == 8
 
 
-def test_sharded_matches_single_device():
+@pytest.mark.parametrize("spmd_mode", ["shard_map", "gspmd"])
+def test_sharded_matches_single_device(spmd_mode):
     mesh = make_facet_mesh()
-    cfg_mesh = SwiftlyConfig(backend="jax", mesh=mesh, **TEST_PARAMS)
+    cfg_mesh = SwiftlyConfig(backend="jax", mesh=mesh, spmd_mode=spmd_mode,
+                             **TEST_PARAMS)
     cfg_single = SwiftlyConfig(backend="jax", **TEST_PARAMS)
     _, _, _, facets_mesh = _roundtrip(cfg_mesh)
     _, _, _, facets_single = _roundtrip(cfg_single)
     np.testing.assert_allclose(
         np.asarray(facets_mesh), np.asarray(facets_single), atol=1e-13
     )
+
+
+def test_shard_map_psum_in_program():
+    """The shard_map forward program must contain an explicit psum."""
+    from swiftly_tpu.parallel import sharded
+
+    mesh = make_facet_mesh()
+    config = SwiftlyConfig(backend="jax", mesh=mesh, **TEST_PARAMS)
+    core = config.core
+    fn = sharded._forward_kernel(core, mesh, TEST_PARAMS["xA_size"])
+    F, m, yN = 8, core.xM_yN_size, core.yN_size
+    import jax.numpy as jnp
+
+    args = (
+        jnp.zeros((F, m, yN), dtype=core.dtype),
+        jnp.zeros(F, dtype=int),
+        jnp.zeros(F, dtype=int),
+        jnp.zeros(2, dtype=int),
+        jnp.ones(TEST_PARAMS["xA_size"]),
+        jnp.ones(TEST_PARAMS["xA_size"]),
+    )
+    text = fn.lower(*args).as_text()
+    assert "all_reduce" in text
 
 
 def test_mesh_subset_of_devices():
