@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Runs the end-to-end streaming demo on every host of a TPU VM pod slice.
+# Invoke on all workers (see README.md); each host executes the same SPMD
+# program and jax.distributed.initialize() assembles the global mesh.
+set -euo pipefail
+
+REPO_DIR="${REPO_DIR:-$HOME/swiftly-tpu}"
+CONFIG="${SWIFT_CONFIG:-8k[1]-n4k-512}"
+QUEUE_SIZE="${QUEUE_SIZE:-300}"
+LRU_FORWARD="${LRU_FORWARD:-3}"
+LRU_BACKWARD="${LRU_BACKWARD:-4}"
+
+cd "$REPO_DIR"
+python scripts/demo_api.py \
+    --swift_config "$CONFIG" \
+    --backend planar \
+    --mesh_devices all \
+    --multihost \
+    --queue_size "$QUEUE_SIZE" \
+    --lru_forward "$LRU_FORWARD" \
+    --lru_backward "$LRU_BACKWARD"

@@ -20,7 +20,13 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from scripts.utils import cli_parser, human_readable_size, make_sources, setup_jax
+from scripts.utils import (
+    cli_parser,
+    human_readable_size,
+    make_sources,
+    resolve_mesh,
+    setup_jax,
+)
 
 log = logging.getLogger("swiftly-tpu.demo")
 
@@ -37,14 +43,9 @@ def demo_api(args, params):
         make_full_facet_cover,
         make_full_subgrid_cover,
     )
-    from swiftly_tpu.parallel.mesh import make_facet_mesh
     from swiftly_tpu.utils.profiling import device_memory_stats, trace
 
-    mesh = (
-        make_facet_mesh(n_devices=args.mesh_devices)
-        if args.mesh_devices
-        else None
-    )
+    mesh = resolve_mesh(args.mesh_devices)
     config = SwiftlyConfig(backend=args.backend, mesh=mesh, **params)
 
     rng = np.random.default_rng(1)

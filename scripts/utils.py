@@ -21,6 +21,18 @@ def human_readable_size(size: float, decimal_places: int = 3) -> str:
     return f"{size:.{decimal_places}f} {unit}"
 
 
+def _mesh_devices_arg(value: str) -> str:
+    """Validate --mesh_devices at parse time: an integer or 'all'."""
+    if value != "all":
+        try:
+            int(value)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an integer or 'all', got {value!r}"
+            ) from None
+    return value
+
+
 def cli_parser(description: str) -> argparse.ArgumentParser:
     """Common demo CLI. Supports @file argument files (one arg per line)."""
     parser = argparse.ArgumentParser(
@@ -65,9 +77,16 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--mesh_devices",
-        type=int,
-        default=0,
-        help="shard facets over this many devices (0 = single device)",
+        type=_mesh_devices_arg,
+        default="0",
+        help="shard facets over this many devices "
+             "(0 = single device, 'all' = every visible device)",
+    )
+    parser.add_argument(
+        "--multihost",
+        action="store_true",
+        help="initialise jax.distributed for a multi-host pod slice "
+             "(run the same command on every host)",
     )
     parser.add_argument(
         "--profile_dir",
@@ -87,11 +106,25 @@ def setup_jax(args):
     """
     import jax
 
+    if getattr(args, "multihost", False):
+        from swiftly_tpu.parallel.mesh import initialize_multihost
+
+        initialize_multihost()
     if args.precision == "f64":
         jax.config.update("jax_enable_x64", True)
     if args.backend != "planar" or args.precision == "f64":
         jax.config.update("jax_platforms", "cpu")
     return jax
+
+
+def resolve_mesh(mesh_devices: str):
+    """Build the facet mesh described by the --mesh_devices argument."""
+    from swiftly_tpu.parallel.mesh import make_facet_mesh
+
+    if mesh_devices == "all":
+        return make_facet_mesh()
+    n = int(mesh_devices)
+    return make_facet_mesh(n_devices=n) if n else None
 
 
 def make_sources(rng, count, image_size, fov=1.0):

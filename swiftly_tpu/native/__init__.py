@@ -45,13 +45,12 @@ def _build_library() -> Path:
         str(_SRC), "-o", tmp,
     ]
     try:
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except subprocess.CalledProcessError as err:
-            raise RuntimeError(
-                f"Native backend build failed:\n{err.stderr}"
-            ) from err
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
+    except subprocess.CalledProcessError as err:
+        raise RuntimeError(
+            f"Native backend build failed:\n{err.stderr}"
+        ) from err
     finally:
         if os.path.exists(tmp):  # compile failed or g++ missing
             os.unlink(tmp)

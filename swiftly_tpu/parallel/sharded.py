@@ -34,6 +34,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..ops.core import prepare_subgrid_math
 from .batched import (
     facet_contrib_to_subgrid,
@@ -48,7 +53,10 @@ __all__ = [
 ]
 
 
-@functools.lru_cache(maxsize=None)
+# Bounded: long-lived processes sweeping many configurations must not pin
+# every (core, mesh) pair's compiled executable forever. Evicted kernels
+# simply recompile on next use.
+@functools.lru_cache(maxsize=32)
 def _forward_kernel(core, mesh, subgrid_size: int):
     """Build the jitted shard_map program for one (core, mesh, size)."""
 
@@ -63,7 +71,7 @@ def _forward_kernel(core, mesh, subgrid_size: int):
             core, summed, sg_offs, subgrid_size, mask0, mask1
         )
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P()),
@@ -92,7 +100,7 @@ def subgrid_from_columns_sharded(
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def _backward_kernel(core, mesh):
     def body(subgrid, sg_offs, offs0, offs1):
         prepped = prepare_subgrid_math(
@@ -103,7 +111,7 @@ def _backward_kernel(core, mesh):
         )
         return jax.vmap(extract)(offs0, offs1)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P(FACET_AXIS), P(FACET_AXIS)),
