@@ -59,14 +59,15 @@ def main():
     # --- accelerated run (planar backend) --------------------------------
     config, fwd, subgrid_configs, sources = _build("planar", params, dtype)
 
-    # Warmup: compile all kernels on one subgrid
-    warm = fwd.get_subgrid_task(subgrid_configs[0])
-    np.asarray(warm)
+    # Warmup: compile all kernels on the first column's subgrids
+    first_col = [
+        sg for sg in subgrid_configs if sg.off0 == subgrid_configs[0].off0
+    ]
+    for w in fwd.get_subgrid_tasks(first_col):
+        w.block_until_ready()
 
     t0 = time.time()
-    results = []
-    for sg in subgrid_configs:
-        results.append(fwd.get_subgrid_task(sg))
+    results = fwd.get_subgrid_tasks(subgrid_configs)
     for r in results:
         r.block_until_ready()
     elapsed = time.time() - t0

@@ -171,6 +171,12 @@ class FlightQueue:
 
     @staticmethod
     def _ready(item):
+        # Accumulators are donated to their successor computation; a
+        # queued buffer may therefore already be deleted by the time we
+        # would block on it — its successor in the queue covers it.
+        deleted = getattr(item, "is_deleted", None)
+        if deleted is not None and deleted():
+            return
         if hasattr(item, "block_until_ready"):
             item.block_until_ready()
 
@@ -348,6 +354,39 @@ class SwiftlyForward:
             )
         self.queue.admit([subgrid])
         return subgrid
+
+    def get_subgrid_tasks(self, subgrid_configs):
+        """Compute many subgrids, one program per column.
+
+        Groups the requests by column offset (off0) and computes each
+        column's subgrids in a single batched program — same results as
+        mapping `get_subgrid_task`, with far fewer dispatches. Returns the
+        subgrids in input order.
+        """
+        if self.mesh is not None or self.core.backend in ("numpy", "native"):
+            return [self.get_subgrid_task(sg) for sg in subgrid_configs]
+        groups = {}  # (off0, size) -> list of input indices
+        for i, sg in enumerate(subgrid_configs):
+            groups.setdefault((sg.off0, sg.size), []).append(i)
+        results = [None] * len(subgrid_configs)
+        for (off0, size), idxs in groups.items():
+            cols = self._get_columns(off0)
+            stacked = batched.subgrids_from_columns_batch(
+                self.core,
+                cols,
+                self._offs0,
+                self._offs1,
+                [(subgrid_configs[i].off0, subgrid_configs[i].off1)
+                 for i in idxs],
+                size,
+                [_subgrid_masks(subgrid_configs[i]) for i in idxs],
+            )
+            # One queue slot per subgrid, not per program: queue_size
+            # keeps bounding in-flight *subgrids* regardless of batching.
+            self.queue.admit([stacked] * len(idxs))
+            for k, i in enumerate(idxs):
+                results[i] = stacked[k]
+        return results
 
 
 # ---------------------------------------------------------------------------

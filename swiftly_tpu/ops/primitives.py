@@ -118,27 +118,39 @@ def roll_axis(a, shift, axis: int):
 
 
 def wrapped_extract(a, n: int, shift, axis: int):
-    """Gather the length-`n` centre window of `a` after a circular shift.
+    """Extract the length-`n` centre window of `a` after a circular shift.
 
-    Equivalent to ``extract_mid(roll(a, -shift, axis), n, axis)`` but gathers
+    Equivalent to ``extract_mid(roll(a, -shift, axis), n, axis)`` but moves
     only `n` elements instead of rolling the full array. `shift` may be a
-    traced scalar; `n` is static.
+    traced scalar; `n` is static. Formulated as one contiguous
+    dynamic-slice of `a` extended by its own head — a sequential-DMA
+    pattern TPUs execute far faster than a gather.
     """
     size = a.shape[axis]
-    idx = (size // 2 - n // 2 + jnp.arange(n) + shift) % size
-    return jnp.take(a, idx, axis=axis)
+    start = jnp.mod(size // 2 - n // 2 + shift, size)
+    buf = jnp.concatenate(
+        [a, jax.lax.slice_in_dim(a, 0, n, axis=axis)], axis=axis
+    )
+    return jax.lax.dynamic_slice_in_dim(buf, start, n, axis=axis)
 
 
 def wrapped_embed(a, n: int, shift, axis: int):
-    """Scatter `a` into the centre of a length-`n` zero array, then shift.
+    """Embed `a` into the centre of a length-`n` zero array, then shift.
 
-    Equivalent to ``roll(pad_mid(a, n, axis), shift, axis)`` with wraparound,
-    but scatters only ``a.shape[axis]`` elements. `shift` may be traced;
-    `n` is static. Adjoint of :func:`wrapped_extract`.
+    Equivalent to ``roll(pad_mid(a, n, axis), shift, axis)`` with
+    wraparound, but moves only ``a.shape[axis]`` elements. `shift` may be
+    traced; `n` is static (`n >= a.shape[axis]`). Adjoint of
+    :func:`wrapped_extract`: one contiguous dynamic-update-slice into an
+    extended zero buffer whose tail is folded back onto its head.
     """
     m = a.shape[axis]
-    idx = (n // 2 - m // 2 + jnp.arange(m) + shift) % n
-    moved = jnp.moveaxis(a, axis, 0)
-    out_shape = (n,) + moved.shape[1:]
-    out = jnp.zeros(out_shape, dtype=a.dtype).at[idx].set(moved)
-    return jnp.moveaxis(out, 0, axis)
+    start = jnp.mod(n // 2 - m // 2 + shift, n)
+    buf_shape = list(a.shape)
+    buf_shape[axis] = n + m
+    buf = jnp.zeros(buf_shape, dtype=a.dtype)
+    buf = jax.lax.dynamic_update_slice_in_dim(buf, a, start, axis=axis)
+    main = jax.lax.slice_in_dim(buf, 0, n, axis=axis)
+    wrap = jax.lax.slice_in_dim(buf, n, n + m, axis=axis)
+    sl = [slice(None)] * a.ndim
+    sl[axis] = slice(0, m)
+    return main.at[tuple(sl)].add(wrap)

@@ -50,6 +50,7 @@ __all__ = [
     "prepare_facets_batch",
     "split_subgrid_batch",
     "subgrid_from_columns_batch",
+    "subgrids_from_columns_batch",
 ]
 
 
@@ -206,6 +207,57 @@ def subgrid_from_columns_batch(
     )
 
 
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _subgrids_from_columns_multi_j(
+    core, NMBF_BFs, offs0, offs1, sg_offs_arr, subgrid_size, masks0, masks1
+):
+    def one(sg_offs, mask0, mask1):
+        contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
+            core, NMBF_BF, foff0, foff1, sg_offs[1]
+        )
+        summed = jnp.sum(jax.vmap(contrib)(NMBF_BFs, offs0, offs1), axis=0)
+        return finish_masked_subgrid(
+            core, summed, sg_offs, subgrid_size, mask0, mask1
+        )
+
+    return jax.vmap(one)(sg_offs_arr, masks0, masks1)
+
+
+def subgrids_from_columns_batch(
+    core, NMBF_BFs, offs0, offs1, sg_offs_list, subgrid_size, masks_list
+):
+    """All subgrids of one column in a single program: [S, xA, xA].
+
+    vmap over the subgrid axis on top of the per-facet vmap — one XLA
+    dispatch computes a whole column of subgrids, amortising launch
+    overhead (the per-subgrid variant is `subgrid_from_columns_batch`).
+
+    :param sg_offs_list: [(off0, off1), ...] for the column's subgrids
+    :param masks_list: [(mask0, mask1), ...] matching sg_offs_list
+    """
+    if _is_host(core):
+        return np.stack(
+            [
+                subgrid_from_columns_batch(
+                    core, NMBF_BFs, offs0, offs1, so[0], so[1],
+                    subgrid_size, masks,
+                )
+                for so, masks in zip(sg_offs_list, masks_list)
+            ]
+        )
+    rdt = core._Fb.dtype
+    return _subgrids_from_columns_multi_j(
+        core,
+        NMBF_BFs,
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        jnp.asarray(sg_offs_list),
+        subgrid_size,
+        jnp.asarray(np.stack([m[0] for m in masks_list]), rdt),
+        jnp.asarray(np.stack([m[1] for m in masks_list]), rdt),
+    )
+
+
 # -- subgrid -> facet -------------------------------------------------------
 
 
@@ -241,7 +293,9 @@ def split_subgrid_batch(core, subgrid, sg_off0, sg_off1, offs0, offs1):
     )
 
 
-@functools.partial(jax.jit, static_argnums=0)
+# The old accumulator value is dead after each fold — donate it so XLA
+# updates in place instead of allocating a fresh [F, m, yN] per subgrid.
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=3)
 def _accumulate_column_j(core, NAF_NAFs, sg_off1, NAF_MNAFs):
     fn = lambda c: add_to_facet_math(core._p, core.yN_size, core.N, c, sg_off1, 1)
     return NAF_MNAFs + jax.vmap(fn)(NAF_NAFs)
@@ -260,7 +314,7 @@ def accumulate_column_batch(core, NAF_NAFs, sg_off1, NAF_MNAFs):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5))
+@functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=6)
 def _accumulate_facet_j(core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size,
                         MNAF_BMNAFs):
     p = core._p

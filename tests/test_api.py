@@ -142,3 +142,22 @@ def test_backward_finish_twice_raises():
     bwd.finish()
     with pytest.raises(RuntimeError):
         bwd.add_new_subgrid_task(make_full_subgrid_cover(config)[0], None)
+
+
+def test_batched_column_forward_matches_per_subgrid():
+    """get_subgrid_tasks (one program per column) == get_subgrid_task."""
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_configs = make_full_facet_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    fwd_a = SwiftlyForward(config, facet_tasks, 2, 50)
+    fwd_b = SwiftlyForward(config, facet_tasks, 2, 50)
+    batch = fwd_a.get_subgrid_tasks(subgrid_configs)
+    for sg_config, got in zip(subgrid_configs, batch):
+        single = fwd_b.get_subgrid_task(sg_config)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(single), atol=1e-14
+        )
