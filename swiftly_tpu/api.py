@@ -464,6 +464,44 @@ class SwiftlyBackward:
         self.queue.admit([col])
         return col
 
+    def add_new_subgrid_tasks(self, tasks):
+        """Fold many (subgrid_config, subgrid_data) pairs, one program per
+        column.
+
+        Equivalent to mapping `add_new_subgrid_task`; groups the inputs by
+        column offset (off0) and folds each group with a single scanned
+        program. Accumulation is linear, so grouping does not change the
+        result.
+        """
+        if self._finished:
+            raise RuntimeError("finish() was already called")
+        if self.mesh is not None or self.core.backend in ("numpy", "native"):
+            for sg_config, data in tasks:
+                self.add_new_subgrid_task(sg_config, data)
+            return
+        core, stack = self.core, self.stack
+        groups = {}
+        for sg_config, data in tasks:
+            groups.setdefault((sg_config.off0, sg_config.size), []).append(
+                (sg_config, data)
+            )
+        for (off0, _size), group in groups.items():
+            col = self.lru.get(off0)
+            if col is None:
+                col = self._zeros((len(stack), core.xM_yN_size, core.yN_size))
+            col = batched.split_accumulate_batch(
+                core,
+                [d for _, d in group],
+                [(sg.off0, sg.off1) for sg, _ in group],
+                self._offs0,
+                self._offs1,
+                col,
+            )
+            evicted_off0, evicted = self.lru.set(off0, col)
+            if evicted is not None:
+                self._fold_column(evicted_off0, evicted)
+            self.queue.admit([col] * len(group))
+
     def _fold_column(self, off0, col):
         core, stack = self.core, self.stack
         if self._MNAF_BMNAFs is None:

@@ -178,12 +178,22 @@ def _cmatmul(zr, zi, w, spec, dtype):
 def _fft_direct_centred(z, sign: int):
     """Centred DFT along the second-to-last axis of planar z (..., n, 2):
     a single round of matmuls (shifts and inverse scale live in the
-    matrix)."""
+    matrix). With SWIFTLY_PALLAS=1 the four real products run as one
+    fused Pallas kernel (see ops/pallas_kernels.py)."""
     n = z.shape[-2]
-    outr, outi = _cmatmul(
-        z[..., 0], z[..., 1], _dft_matrix(n, sign, True),
-        "...i,ik->...k", z.dtype,
-    )
+    w = _dft_matrix(n, sign, True)
+    from .pallas_kernels import cmatmul_pallas, pallas_enabled
+
+    if pallas_enabled():
+        lead = z.shape[:-2]
+        zr = z[..., 0].reshape(-1, n)
+        zi = z[..., 1].reshape(-1, n)
+        outr, outi = cmatmul_pallas(
+            zr, zi,
+            jnp.asarray(w[0], z.dtype), jnp.asarray(w[1], z.dtype),
+        )
+        return jnp.stack([outr, outi], axis=-1).reshape(lead + (n, 2))
+    outr, outi = _cmatmul(z[..., 0], z[..., 1], w, "...i,ik->...k", z.dtype)
     return jnp.stack([outr, outi], axis=-1)
 
 

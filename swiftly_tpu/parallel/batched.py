@@ -48,6 +48,7 @@ __all__ = [
     "extract_columns_batch",
     "finish_facets_batch",
     "prepare_facets_batch",
+    "split_accumulate_batch",
     "split_subgrid_batch",
     "subgrid_from_columns_batch",
     "subgrids_from_columns_batch",
@@ -290,6 +291,55 @@ def split_subgrid_batch(core, subgrid, sg_off0, sg_off1, offs0, offs1):
         jnp.asarray([sg_off0, sg_off1]),
         jnp.asarray(offs0),
         jnp.asarray(offs1),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0, donate_argnums=4)
+def _split_accumulate_multi_j(core, subgrids, sg_offs_arr, foffs, NAF_MNAFs):
+    offs0, offs1 = foffs
+
+    def step(acc, xs):
+        subgrid, sg_offs = xs
+        prepped = prepare_subgrid_math(
+            core._p, core.xM_size, subgrid, sg_offs
+        )
+        extract = lambda foff0, foff1: subgrid_contrib_to_facet(
+            core, prepped, foff0, foff1
+        )
+        NAF_NAFs = jax.vmap(extract)(offs0, offs1)
+        fold = lambda c: add_to_facet_math(
+            core._p, core.yN_size, core.N, c, sg_offs[1], 1
+        )
+        return acc + jax.vmap(fold)(NAF_NAFs), None
+
+    # scan keeps the live set at one [F, m, yN] accumulator instead of
+    # materialising all S subgrids' contributions at once.
+    acc, _ = jax.lax.scan(step, NAF_MNAFs, (subgrids, sg_offs_arr))
+    return acc
+
+
+def split_accumulate_batch(core, subgrids, sg_offs_list, offs0, offs1,
+                           NAF_MNAFs):
+    """Fold a whole column of subgrids into its accumulator in one program.
+
+    Equivalent to `split_subgrid_batch` + `accumulate_column_batch` per
+    subgrid; `subgrids` is the stacked [S, xA, xA] column, `sg_offs_list`
+    the matching [(off0, off1), ...]. Returns the updated NAF_MNAFs
+    [F, m, yN] (input donated on device backends).
+    """
+    if _is_host(core):
+        for sg, (o0, o1) in zip(subgrids, sg_offs_list):
+            NAF_NAFs = split_subgrid_batch(core, sg, o0, o1, offs0, offs1)
+            NAF_MNAFs = accumulate_column_batch(core, NAF_NAFs, o1, NAF_MNAFs)
+        return NAF_MNAFs
+    if isinstance(subgrids, (list, tuple)):
+        subgrids = jnp.stack([core._prep(sg) for sg in subgrids])
+    return _split_accumulate_multi_j(
+        core,
+        subgrids,
+        jnp.asarray(sg_offs_list),
+        (jnp.asarray(offs0), jnp.asarray(offs1)),
+        NAF_MNAFs,
     )
 
 

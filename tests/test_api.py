@@ -21,6 +21,7 @@ from swiftly_tpu import (
     make_facet,
     make_full_facet_cover,
     make_full_subgrid_cover,
+    make_subgrid,
 )
 
 TEST_PARAMS = {
@@ -161,3 +162,24 @@ def test_batched_column_forward_matches_per_subgrid():
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(single), atol=1e-14
         )
+
+
+def test_batched_backward_matches_per_subgrid():
+    """add_new_subgrid_tasks (column-scanned) == add_new_subgrid_task."""
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_configs = make_full_facet_cover(config)
+    tasks = [
+        (sg, make_subgrid(config.image_size, sg, SOURCES))
+        for sg in subgrid_configs
+    ]
+    bwd_a = SwiftlyBackward(config, facet_configs, 2, 50)
+    bwd_a.add_new_subgrid_tasks(tasks)
+    facets_a = bwd_a.finish()
+    bwd_b = SwiftlyBackward(config, facet_configs, 2, 50)
+    for sg, data in tasks:
+        bwd_b.add_new_subgrid_task(sg, data)
+    facets_b = bwd_b.finish()
+    np.testing.assert_allclose(
+        np.asarray(facets_a), np.asarray(facets_b), atol=1e-12
+    )
