@@ -15,6 +15,7 @@ from .api import (
     SwiftlyBackward,
     SwiftlyConfig,
     SwiftlyForward,
+    backward_all,
     check_facet,
     check_residual,
     check_subgrid,
@@ -44,6 +45,7 @@ __all__ = [
     "SwiftlyConfig",
     "SwiftlyCore",
     "SwiftlyForward",
+    "backward_all",
     "check_facet",
     "check_residual",
     "check_subgrid",

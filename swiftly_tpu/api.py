@@ -47,6 +47,7 @@ __all__ = [
     "SwiftlyBackward",
     "FlightQueue",
     "LRUCache",
+    "backward_all",
     "check_facet",
     "check_residual",
     "check_subgrid",
@@ -275,6 +276,35 @@ def _subgrid_masks(sg_config):
     return m0, m1
 
 
+def _group_columns(subgrid_configs, key=lambda sg: sg, require_one_size=False):
+    """Group items by subgrid column offset (off0), preserving order.
+
+    :param key: maps an item to its SubgridConfig
+    :param require_one_size: raise on mixed subgrid sizes (callers whose
+        output is stacked cannot handle them); otherwise mixed sizes just
+        make the grouping non-rectangular
+    :return: (groups, rectangular) — groups is {off0: [item, ...]};
+        rectangular is True when all subgrids share one size and all
+        columns have equal length (the shape the fused whole-cover
+        programs require).
+    """
+    groups = {}
+    for item in subgrid_configs:  # may be any iterable, incl. a generator
+        groups.setdefault(key(item).off0, []).append(item)
+    if not groups:
+        raise ValueError("At least one subgrid is required")
+    sizes = {key(item).size for col in groups.values() for item in col}
+    if require_one_size and len(sizes) != 1:
+        raise ValueError(
+            f"All subgrids must share one size for stacked output "
+            f"(got sizes {sorted(sizes)})"
+        )
+    rectangular = (
+        len(sizes) == 1 and len({len(v) for v in groups.values()}) == 1
+    )
+    return groups, rectangular
+
+
 # ---------------------------------------------------------------------------
 # Forward: facets -> subgrids
 # ---------------------------------------------------------------------------
@@ -387,6 +417,56 @@ class SwiftlyForward:
             for k, i in enumerate(idxs):
                 results[i] = stacked[k]
         return results
+
+    def all_subgrids(self, subgrid_configs):
+        """Every requested subgrid as ONE fused program.
+
+        Returns a stacked device array [n, xA, xA(, 2)] in request order —
+        a single XLA dispatch (scan over columns) and thus a single host
+        sync for the entire forward transform; the latency-optimal path
+        for remote-attached TPUs. Falls back to the per-column streaming
+        path for irregular (ragged-column) covers, meshes, and host
+        backends. All subgrids must share one size (the output is
+        stacked); raises ValueError otherwise.
+        """
+        groups, rectangular = _group_columns(
+            enumerate(subgrid_configs),
+            key=lambda item: item[1],
+            require_one_size=True,
+        )
+        if (
+            not rectangular
+            or self.mesh is not None
+            or self.core.backend in ("numpy", "native")
+        ):
+            import jax.numpy as jnp
+
+            tasks = self.get_subgrid_tasks(subgrid_configs)
+            if self.core.backend in ("numpy", "native"):
+                return np.stack([np.asarray(t) for t in tasks])
+            return jnp.stack(tasks)
+        import jax.numpy as jnp
+
+        size = subgrid_configs[0].size
+        col_offs0 = list(groups)
+        sg_offs1, masks0, masks1, order = [], [], [], []
+        for off0 in col_offs0:
+            idxs = [i for i, _ in groups[off0]]
+            order.extend(idxs)
+            sg_offs1.append([subgrid_configs[i].off1 for i in idxs])
+            ms = [_subgrid_masks(subgrid_configs[i]) for i in idxs]
+            masks0.append([m[0] for m in ms])
+            masks1.append([m[1] for m in ms])
+        stacked = batched.forward_all_batch(
+            self.core, self._get_BF_Fs(), self._offs0, self._offs1,
+            col_offs0, sg_offs1, size, masks0, masks1,
+        )
+        flat = stacked.reshape((len(subgrid_configs),) + stacked.shape[2:])
+        if order != list(range(len(subgrid_configs))):
+            inv = np.argsort(np.asarray(order))
+            flat = jnp.take(flat, jnp.asarray(inv), axis=0)
+        self.queue.admit([flat])
+        return flat
 
 
 # ---------------------------------------------------------------------------
@@ -533,3 +613,45 @@ class SwiftlyBackward:
         self.queue.drain()
         self._finished = True
         return facets[: self.stack.n_real]
+
+
+def backward_all(swiftly_config, facet_configs, subgrid_tasks):
+    """The full subgrid->facet transform as ONE fused program.
+
+    :param subgrid_tasks: list of (SubgridConfig, subgrid_data) pairs
+        covering the grid
+    :return: finished facet stack [F, yB, yB(, 2)] matching facet_configs
+
+    Single XLA dispatch (scan over subgrid columns); numerically identical
+    to streaming the same subgrids through `SwiftlyBackward` (every
+    accumulation is a sum of linear contributions). Falls back to the
+    streaming path for irregular covers, meshes, and host backends.
+    """
+    core = swiftly_config.core
+    mesh = getattr(swiftly_config, "mesh", None)
+    groups, rectangular = _group_columns(
+        subgrid_tasks, key=lambda item: item[0]
+    )
+    if not rectangular or mesh is not None or core.backend in (
+        "numpy", "native",
+    ):
+        bwd = SwiftlyBackward(swiftly_config, facet_configs)
+        bwd.add_new_subgrid_tasks(subgrid_tasks)
+        return bwd.finish()
+    import jax.numpy as jnp
+
+    stack = _FacetStack(facet_configs)
+    subgrids = jnp.stack(
+        [
+            jnp.stack([core._prep(d) for _, d in groups[off0]])
+            for off0 in groups
+        ]
+    )
+    sg_offs = [
+        [(sg.off0, sg.off1) for sg, _ in groups[off0]] for off0 in groups
+    ]
+    facets = batched.backward_all_batch(
+        core, subgrids, sg_offs, stack.offs0, stack.offs1,
+        stack.masks0, stack.masks1, stack.size,
+    )
+    return facets[: stack.n_real]

@@ -45,8 +45,10 @@ from ..ops.core import (
 __all__ = [
     "accumulate_column_batch",
     "accumulate_facet_batch",
+    "backward_all_batch",
     "extract_columns_batch",
     "finish_facets_batch",
+    "forward_all_batch",
     "prepare_facets_batch",
     "split_accumulate_batch",
     "split_subgrid_batch",
@@ -259,6 +261,83 @@ def subgrids_from_columns_batch(
     )
 
 
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _forward_all_j(
+    core, BF_Fs, foffs, col_offs0, sg_offs1, subgrid_size, masks0, masks1
+):
+    offs0, offs1 = foffs
+
+    def one_column(_, xs):
+        off0, col_sg_offs1, col_m0, col_m1 = xs
+        cols = _extract_columns_j(core, BF_Fs, off0, offs1)
+
+        def one_sg(off1, mask0, mask1):
+            contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
+                core, NMBF_BF, foff0, foff1, off1
+            )
+            summed = jnp.sum(jax.vmap(contrib)(cols, offs0, offs1), axis=0)
+            return finish_masked_subgrid(
+                core,
+                summed,
+                jnp.stack([off0, off1]),
+                subgrid_size,
+                mask0,
+                mask1,
+            )
+
+        return None, jax.vmap(one_sg)(col_sg_offs1, col_m0, col_m1)
+
+    _, subgrids = jax.lax.scan(
+        one_column, None, (col_offs0, sg_offs1, masks0, masks1)
+    )
+    return subgrids
+
+
+def forward_all_batch(
+    core, BF_Fs, offs0, offs1, col_offs0, sg_offs1, subgrid_size,
+    masks0, masks1,
+):
+    """The full forward cover as ONE program: [C, S, xA, xA].
+
+    Scans over the C subgrid columns; per column, extracts the facet
+    column blocks once and vmaps over its S subgrids. One XLA dispatch
+    (and one host sync) computes every subgrid of the cover — the
+    dispatch/sync-latency-optimal shape for remote-attached TPUs.
+
+    :param col_offs0: [C] column offsets
+    :param sg_offs1: [C, S] per-column subgrid off1 values
+    :param masks0/masks1: [C, S, xA] per-subgrid ownership masks
+    """
+    if _is_host(core):
+        out = []
+        for c, off0 in enumerate(col_offs0):
+            cols = extract_columns_batch(core, BF_Fs, off0, offs1)
+            out.append(
+                np.stack(
+                    [
+                        subgrid_from_columns_batch(
+                            core, cols, offs0, offs1, off0, sg_offs1[c][s],
+                            subgrid_size,
+                            (masks0[c][s], masks1[c][s]),
+                        )
+                        for s in range(len(sg_offs1[c]))
+                    ]
+                )
+            )
+        return np.stack(out)
+    rdt = core._Fb.dtype
+    return _forward_all_j(
+        core,
+        BF_Fs,
+        (jnp.asarray(offs0), jnp.asarray(offs1)),
+        jnp.asarray(col_offs0),
+        jnp.asarray(sg_offs1),
+        subgrid_size,
+        jnp.asarray(np.asarray(masks0), rdt),
+        jnp.asarray(np.asarray(masks1), rdt),
+    )
+
+
 # -- subgrid -> facet -------------------------------------------------------
 
 
@@ -294,8 +373,7 @@ def split_subgrid_batch(core, subgrid, sg_off0, sg_off1, offs0, offs1):
     )
 
 
-@functools.partial(jax.jit, static_argnums=0, donate_argnums=4)
-def _split_accumulate_multi_j(core, subgrids, sg_offs_arr, foffs, NAF_MNAFs):
+def _split_accumulate_fn(core, subgrids, sg_offs_arr, foffs, NAF_MNAFs):
     offs0, offs1 = foffs
 
     def step(acc, xs):
@@ -316,6 +394,11 @@ def _split_accumulate_multi_j(core, subgrids, sg_offs_arr, foffs, NAF_MNAFs):
     # materialising all S subgrids' contributions at once.
     acc, _ = jax.lax.scan(step, NAF_MNAFs, (subgrids, sg_offs_arr))
     return acc
+
+
+_split_accumulate_multi_j = functools.partial(
+    jax.jit, static_argnums=0, donate_argnums=4
+)(_split_accumulate_fn)
 
 
 def split_accumulate_batch(core, subgrids, sg_offs_list, offs0, offs1,
@@ -364,9 +447,8 @@ def accumulate_column_batch(core, NAF_NAFs, sg_off1, NAF_MNAFs):
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5), donate_argnums=6)
-def _accumulate_facet_j(core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size,
-                        MNAF_BMNAFs):
+def _accumulate_facet_fn(core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size,
+                         MNAF_BMNAFs):
     p = core._p
 
     def fold(NAF_MNAF, off1, mask1):
@@ -377,6 +459,11 @@ def _accumulate_facet_j(core, NAF_MNAFs, sg_off0, offs1, masks1, facet_size,
         return add_to_facet_math(p, core.yN_size, core.N, NAF_BMNAF, sg_off0, 0)
 
     return MNAF_BMNAFs + jax.vmap(fold)(NAF_MNAFs, offs1, masks1)
+
+
+_accumulate_facet_j = functools.partial(
+    jax.jit, static_argnums=(0, 5), donate_argnums=6
+)(_accumulate_facet_fn)
 
 
 def accumulate_facet_batch(
@@ -409,8 +496,7 @@ def accumulate_facet_batch(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
-def _finish_facets_j(core, MNAF_BMNAFs, offs0, masks0, facet_size):
+def _finish_facets_fn(core, MNAF_BMNAFs, offs0, masks0, facet_size):
     p = core._p
 
     def fin(MNAF_BMNAF, off0, mask0):
@@ -420,6 +506,11 @@ def _finish_facets_j(core, MNAF_BMNAFs, offs0, masks0, facet_size):
         return _mask_along(p, facet, mask0, 0)
 
     return jax.vmap(fin)(MNAF_BMNAFs, offs0, masks0)
+
+
+_finish_facets_j = functools.partial(jax.jit, static_argnums=(0, 4))(
+    _finish_facets_fn
+)
 
 
 def finish_facets_batch(core, MNAF_BMNAFs, offs0, masks0, facet_size):
@@ -437,5 +528,86 @@ def finish_facets_batch(core, MNAF_BMNAFs, offs0, masks0, facet_size):
         MNAF_BMNAFs,
         jnp.asarray(offs0),
         jnp.asarray(masks0, core._Fb.dtype),
+        facet_size,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _backward_all_j(
+    core, subgrids, sg_offs, foffs, fmasks, facet_size
+):
+    offs0, offs1 = foffs
+    masks0, masks1 = fmasks
+    p = core._p
+    F = offs0.shape[0]
+    zeros_col = jnp.zeros(
+        (F, core.xM_yN_size, core.yN_size) + subgrids.shape[4:],
+        dtype=subgrids.dtype,
+    )
+
+    def one_column(MNAF_BMNAFs, xs):
+        col_sgs, col_offs = xs
+        NAF_MNAFs = _split_accumulate_fn(
+            core, col_sgs, col_offs, (offs0, offs1), zeros_col
+        )
+        MNAF_BMNAFs = _accumulate_facet_fn(
+            core, NAF_MNAFs, col_offs[0, 0], offs1, masks1, facet_size,
+            MNAF_BMNAFs,
+        )
+        return MNAF_BMNAFs, None
+
+    init = jnp.zeros(
+        (F, core.yN_size, facet_size) + subgrids.shape[4:],
+        dtype=subgrids.dtype,
+    )
+    MNAF_BMNAFs, _ = jax.lax.scan(one_column, init, (subgrids, sg_offs))
+    return _finish_facets_fn(core, MNAF_BMNAFs, offs0, masks0, facet_size)
+
+
+def backward_all_batch(
+    core, subgrids, sg_offs, offs0, offs1, masks0, masks1, facet_size
+):
+    """The full backward cover as ONE program: facets [F, yB, yB].
+
+    Scans over the C subgrid columns (inner scan over each column's S
+    subgrids), folding column accumulators into the per-facet
+    accumulators, then finishes all facets — one XLA dispatch for the
+    whole subgrid->facet transform.
+
+    :param subgrids: [C, S, xA, xA] stacked column-major subgrid data
+    :param sg_offs: [C, S, 2] matching (off0, off1) pairs (off0 constant
+        within a column)
+    """
+    if _is_host(core):
+        MNAF_BMNAFs = np.zeros(
+            (len(offs0), core.yN_size, facet_size), dtype=complex
+        )
+        for c in range(len(subgrids)):
+            col = np.zeros(
+                (len(offs0), core.xM_yN_size, core.yN_size), dtype=complex
+            )
+            col = split_accumulate_batch(
+                core, subgrids[c], [tuple(o) for o in sg_offs[c]],
+                offs0, offs1, col,
+            )
+            MNAF_BMNAFs = accumulate_facet_batch(
+                core, col, sg_offs[c][0][0], offs1, masks1, facet_size,
+                MNAF_BMNAFs,
+            )
+        return finish_facets_batch(
+            core, MNAF_BMNAFs, offs0, masks0, facet_size
+        )
+    if isinstance(subgrids, (list, tuple)):
+        subgrids = jnp.stack(
+            [jnp.stack([core._prep(sg) for sg in col]) for col in subgrids]
+        )
+    rdt = core._Fb.dtype
+    return _backward_all_j(
+        core,
+        subgrids,
+        jnp.asarray(np.asarray(sg_offs)),
+        (jnp.asarray(offs0), jnp.asarray(offs1)),
+        (jnp.asarray(np.asarray(masks0), rdt),
+         jnp.asarray(np.asarray(masks1), rdt)),
         facet_size,
     )

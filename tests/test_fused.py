@@ -1,0 +1,200 @@
+"""Fused whole-cover programs vs the streaming path.
+
+`SwiftlyForward.all_subgrids` / `backward_all` compute the entire
+transform as one XLA program (scan over columns). They must be
+numerically identical (float64) to streaming subgrid-by-subgrid — the
+fused forms only regroup sums of linear contributions.
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SwiftlyBackward,
+    SwiftlyConfig,
+    SwiftlyForward,
+    backward_all,
+    check_facet,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+)
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+SOURCES = [(1, 1, 0)]
+
+
+def _setup(backend, dtype=None):
+    config = SwiftlyConfig(backend=backend, dtype=dtype, **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_configs, subgrid_configs, facet_tasks
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_forward_all_matches_streaming(backend):
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    fwd = SwiftlyForward(config, facet_tasks)
+    streamed = [
+        config.core.as_complex(fwd.get_subgrid_task(sg))
+        for sg in subgrid_configs
+    ]
+    fwd2 = SwiftlyForward(config, facet_tasks)
+    fused = config.core.as_complex(fwd2.all_subgrids(subgrid_configs))
+    assert fused.shape[0] == len(subgrid_configs)
+    np.testing.assert_allclose(
+        fused, np.stack(streamed), rtol=0, atol=1e-12
+    )
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_forward_all_request_order(backend):
+    """Shuffled request order returns subgrids in that order."""
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    rng = np.random.default_rng(7)
+    perm = rng.permutation(len(subgrid_configs))
+    shuffled = [subgrid_configs[i] for i in perm]
+    fwd = SwiftlyForward(config, facet_tasks)
+    fused = config.core.as_complex(fwd.all_subgrids(subgrid_configs))
+    fwd2 = SwiftlyForward(config, facet_tasks)
+    fused_shuf = config.core.as_complex(fwd2.all_subgrids(shuffled))
+    np.testing.assert_allclose(
+        fused_shuf, fused[perm], rtol=0, atol=0
+    )
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_backward_all_matches_streaming(backend):
+    config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
+    fwd = SwiftlyForward(config, facet_tasks)
+    tasks = [
+        (sg, fwd.get_subgrid_task(sg)) for sg in subgrid_configs
+    ]
+    bwd = SwiftlyBackward(config, facet_configs)
+    bwd.add_new_subgrid_tasks(tasks)
+    streamed = config.core.as_complex(bwd.finish())
+    fused = config.core.as_complex(
+        backward_all(config, facet_configs, tasks)
+    )
+    np.testing.assert_allclose(fused, streamed, rtol=0, atol=1e-12)
+
+
+def test_fused_roundtrip_rms():
+    """E2E fused forward -> fused backward round trip meets the reference
+    accuracy bound (3e-10, tests/test_api.py:125)."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = SwiftlyForward(config, facet_tasks)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = list(zip(subgrid_configs, subgrids))
+    facets = backward_all(config, facet_configs, tasks)
+    for fc, facet in zip(facet_configs, facets):
+        err = check_facet(
+            config.image_size, fc, config.core.as_complex(facet), SOURCES
+        )
+        assert err < 3e-10
+
+
+def test_forward_all_rejects_mixed_sizes_and_empty():
+    config, _, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = SwiftlyForward(config, facet_tasks)
+    with pytest.raises(ValueError, match="share one size"):
+        bad = list(subgrid_configs)
+        bad[0] = bad[0].__class__(
+            off0=bad[0].off0, off1=bad[0].off1, size=bad[0].size - 2,
+            mask0=None, mask1=None,
+        )
+        fwd.all_subgrids(bad)
+    with pytest.raises(ValueError, match="At least one subgrid"):
+        fwd.all_subgrids([])
+
+
+def test_fused_batch_host_branches():
+    """The numpy-core branches of forward_all_batch / backward_all_batch
+    (reachable when the batched kernels are called directly) match the
+    jitted versions."""
+    from swiftly_tpu.api import _FacetStack, _subgrid_masks
+    from swiftly_tpu.parallel import batched
+
+    config_np, facet_configs, subgrid_configs, facet_tasks = _setup("numpy")
+    core = config_np.core
+    stack = _FacetStack(facet_configs)
+    facets = np.stack([np.asarray(d, dtype=complex) for _, d in facet_tasks])
+    BF_Fs = batched.prepare_facets_batch(core, facets, stack.offs0)
+
+    col_offs0 = sorted({sg.off0 for sg in subgrid_configs})
+    cols = {o: [sg for sg in subgrid_configs if sg.off0 == o]
+            for o in col_offs0}
+    sg_offs1 = [[sg.off1 for sg in cols[o]] for o in col_offs0]
+    masks0 = [[_subgrid_masks(sg)[0] for sg in cols[o]] for o in col_offs0]
+    masks1 = [[_subgrid_masks(sg)[1] for sg in cols[o]] for o in col_offs0]
+    size = subgrid_configs[0].size
+
+    fused_np = batched.forward_all_batch(
+        core, BF_Fs, stack.offs0, stack.offs1, col_offs0, sg_offs1, size,
+        masks0, masks1,
+    )
+
+    config_j, *_ = _setup("jax")
+    fwd = SwiftlyForward(config_j, facet_tasks)
+    ordered = [sg for o in col_offs0 for sg in cols[o]]
+    fused_j = config_j.core.as_complex(fwd.all_subgrids(ordered))
+    np.testing.assert_allclose(
+        fused_np.reshape(fused_j.shape), fused_j, rtol=0, atol=1e-12
+    )
+
+    sg_offs = [[(sg.off0, sg.off1) for sg in cols[o]] for o in col_offs0]
+    subgrids = np.stack(
+        [np.stack([np.asarray(fused_np[c][s]) for s in range(len(cols[o]))])
+         for c, o in enumerate(col_offs0)]
+    )
+    facets_np = batched.backward_all_batch(
+        core, subgrids, sg_offs, stack.offs0, stack.offs1,
+        stack.masks0, stack.masks1, stack.size,
+    )
+    for fc, facet in zip(facet_configs, facets_np):
+        err = check_facet(config_np.image_size, fc, np.asarray(facet),
+                          SOURCES)
+        assert err < 3e-10
+
+
+def test_karatsuba_cmatmul(monkeypatch):
+    """The opt-in 3-matmul complex product matches numpy's FFT."""
+    monkeypatch.setenv("SWIFTLY_CMATMUL", "karatsuba")
+    from swiftly_tpu.ops import planar_backend as plk
+
+    rng = np.random.default_rng(3)
+    z = rng.standard_normal((5, 96)) + 1j * rng.standard_normal((5, 96))
+    got = plk.from_planar(plk.fft(plk.to_planar(z, np.float64), 1))
+    ref = np.fft.fftshift(
+        np.fft.fft(np.fft.ifftshift(z, axes=1), axis=1), axes=1
+    )
+    np.testing.assert_allclose(got, ref, rtol=0, atol=1e-9)
+    monkeypatch.setenv("SWIFTLY_CMATMUL", "bogus")
+    with pytest.raises(ValueError, match="SWIFTLY_CMATMUL"):
+        plk.fft(plk.to_planar(z, np.float64), 1)
+
+
+def test_backward_all_numpy_fallback():
+    """Host backends route through the streaming path, same results."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("numpy")
+    fwd = SwiftlyForward(config, facet_tasks)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    assert isinstance(subgrids, np.ndarray)
+    tasks = list(zip(subgrid_configs, subgrids))
+    facets = backward_all(config, facet_configs, tasks)
+    for fc, facet in zip(facet_configs, facets):
+        err = check_facet(config.image_size, fc, np.asarray(facet), SOURCES)
+        assert err < 3e-10
