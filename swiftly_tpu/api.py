@@ -429,6 +429,7 @@ class SwiftlyForward:
         backends. All subgrids must share one size (the output is
         stacked); raises ValueError otherwise.
         """
+        subgrid_configs = list(subgrid_configs)
         groups, rectangular = _group_columns(
             enumerate(subgrid_configs),
             key=lambda item: item[1],
@@ -465,7 +466,9 @@ class SwiftlyForward:
         if order != list(range(len(subgrid_configs))):
             inv = np.argsort(np.asarray(order))
             flat = jnp.take(flat, jnp.asarray(inv), axis=0)
-        self.queue.admit([flat])
+        # One queue slot per subgrid (not per program), like
+        # get_subgrid_tasks: queue_size keeps bounding in-flight subgrids.
+        self.queue.admit([flat] * len(subgrid_configs))
         return flat
 
 
@@ -629,6 +632,7 @@ def backward_all(swiftly_config, facet_configs, subgrid_tasks):
     """
     core = swiftly_config.core
     mesh = getattr(swiftly_config, "mesh", None)
+    subgrid_tasks = list(subgrid_tasks)
     groups, rectangular = _group_columns(
         subgrid_tasks, key=lambda item: item[0]
     )
@@ -638,15 +642,10 @@ def backward_all(swiftly_config, facet_configs, subgrid_tasks):
         bwd = SwiftlyBackward(swiftly_config, facet_configs)
         bwd.add_new_subgrid_tasks(subgrid_tasks)
         return bwd.finish()
-    import jax.numpy as jnp
 
     stack = _FacetStack(facet_configs)
-    subgrids = jnp.stack(
-        [
-            jnp.stack([core._prep(d) for _, d in groups[off0]])
-            for off0 in groups
-        ]
-    )
+    # nested lists: backward_all_batch preps and stacks them itself
+    subgrids = [[d for _, d in groups[off0]] for off0 in groups]
     sg_offs = [
         [(sg.off0, sg.off1) for sg, _ in groups[off0]] for off0 in groups
     ]

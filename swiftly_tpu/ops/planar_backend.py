@@ -165,7 +165,11 @@ import os
 def _cmatmul_algo() -> str:
     """Complex-product algorithm: "karatsuba" (3 real matmuls, ~25% faster,
     ~2x rounding error at f32) or "4mul" (4 real matmuls, most accurate).
-    Read per call so tests can toggle it; unknown values are an error."""
+
+    Read at TRACE time: jitted programs bake in whichever algorithm was
+    active when they first compiled, and the jit cache ignores later
+    changes — set the env var before any transform runs (eager/new-shape
+    calls do re-read it, which is how the unit tests toggle it)."""
     algo = os.environ.get("SWIFTLY_CMATMUL", "4mul")
     if algo not in ("4mul", "karatsuba"):
         raise ValueError(f"SWIFTLY_CMATMUL must be 4mul|karatsuba, got {algo!r}")

@@ -1,5 +1,13 @@
-"""Parallel execution: batched kernels, device meshes, sharded pipelines."""
+"""Parallel execution: batched kernels, device meshes, sharded pipelines,
+out-of-core streamed executors."""
 
-from . import batched, sharded
+from . import batched, sharded, streamed
+from .streamed import StreamedBackward, StreamedForward
 
-__all__ = ["batched", "sharded"]
+__all__ = [
+    "StreamedBackward",
+    "StreamedForward",
+    "batched",
+    "sharded",
+    "streamed",
+]

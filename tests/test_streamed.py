@@ -1,0 +1,139 @@
+"""Out-of-core streamed executor tests.
+
+The streamed path must agree with the batched whole-cover path (same math
+functions, different staging) and with the analytic oracle, for both
+device backends, both buffer residencies, and block sizes that do / do not
+divide the facet size.
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SwiftlyConfig,
+    check_facet,
+    check_subgrid,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+    make_subgrid,
+)
+from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+SOURCES = [(1, 1, 0), (0.5, -30, 40)]
+
+
+def _setup(backend, dtype=None):
+    config = SwiftlyConfig(backend=backend, dtype=dtype, **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_configs, subgrid_configs, facet_tasks
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize("residency", ["host", "device"])
+@pytest.mark.parametrize("col_block", [416, 128])  # exact / ragged blocks
+def test_streamed_forward_vs_oracle(backend, residency, col_block):
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    fwd = StreamedForward(
+        config, facet_tasks, col_block=col_block, residency=residency
+    )
+    out = fwd.all_subgrids(subgrid_configs)
+    assert out.shape[0] == len(subgrid_configs)
+    for i, sg in enumerate(subgrid_configs):
+        err = check_subgrid(
+            config.image_size, sg, config.core.as_complex(out[i]), SOURCES
+        )
+        assert err < 1e-9
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_streamed_forward_matches_batched(backend):
+    from swiftly_tpu import SwiftlyForward
+
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    batched_fwd = SwiftlyForward(config, facet_tasks, 3, 64)
+    ref = np.asarray(batched_fwd.all_subgrids(subgrid_configs))
+    streamed = StreamedForward(config, facet_tasks, col_block=416)
+    out = streamed.all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize("residency", ["host", "device"])
+def test_streamed_roundtrip(backend, residency):
+    config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
+    fwd = StreamedForward(
+        config, facet_tasks, col_block=256, residency=residency
+    )
+    bwd = StreamedBackward(
+        config, facet_configs, col_block=256, residency=residency
+    )
+    for items, subgrids in fwd.stream_columns(subgrid_configs):
+        bwd.add_subgrids(
+            [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+        )
+    facets = bwd.finish()
+    for i, fc in enumerate(facet_configs):
+        err = check_facet(
+            config.image_size, fc, config.core.as_complex(facets[i]), SOURCES
+        )
+        assert err < 3e-10
+
+
+def test_streamed_backward_order_independent():
+    """Feeding subgrids in shuffled order / split batches is equivalent."""
+    import random
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+
+    bwd_a = StreamedBackward(config, facet_configs, col_block=416)
+    bwd_a.add_subgrids(tasks)
+    ref = bwd_a.finish()
+
+    random.Random(7).shuffle(tasks)
+    bwd_b = StreamedBackward(config, facet_configs, col_block=416)
+    # split into three uneven batches, columns interleaved
+    bwd_b.add_subgrids(tasks[:5])
+    bwd_b.add_subgrids(tasks[5:6])
+    bwd_b.add_subgrids(tasks[6:])
+    out = bwd_b.finish()
+    # accumulation order differs -> float non-associativity; the reference's
+    # own shuffle test allows 3e-10 RMS (test_api.py:125)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_streamed_requires_device_backend():
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    with pytest.raises(ValueError, match="device backend"):
+        StreamedForward(config, [(fc, None) for fc in facet_configs])
+
+
+def test_streamed_subgrid_equals_direct_dft():
+    """Streamed subgrids equal make_subgrid's direct DFT (tier-2 parity)."""
+    config, _, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    out = fwd.all_subgrids(subgrid_configs)
+    sg = subgrid_configs[0]
+    direct = make_subgrid(config.image_size, sg, SOURCES)
+    np.testing.assert_array_almost_equal(
+        config.core.as_complex(out[0]), direct, decimal=8
+    )
