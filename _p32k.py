@@ -1,0 +1,35 @@
+import os, time, numpy as np, jax, jax.numpy as jnp
+CFG = os.environ.get("CFG", "32k[1]-n16k-512")
+def log(*a): print(*a, file=open("/tmp/probe/log.txt","a"), flush=True)
+log("=== device-streamed fwd", CFG)
+from swiftly_tpu import (SwiftlyConfig, SWIFT_CONFIGS, check_subgrid,
+                         make_full_facet_cover, make_full_subgrid_cover, make_facet)
+from swiftly_tpu.parallel import StreamedForward
+params = dict(SWIFT_CONFIGS[CFG]); params.setdefault("fov", 1.0)
+config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+fcs = make_full_facet_cover(config); sgs = make_full_subgrid_cover(config)
+sources = [(1.0, 1, 0)]
+t0=time.time()
+f0 = make_facet(config.image_size, fcs[0], sources)
+facet_tasks = [(fc, f0) for fc in fcs]
+log("facet built+replicated", round(time.time()-t0,1))
+def run(label):
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    t0=time.time()
+    acc = None; last = None; n = 0; kept = {}
+    for items, out in fwd.stream_columns(sgs, device_arrays=True):
+        s = jnp.sum(out * out)  # force materialisation, keep on device
+        acc = s if acc is None else acc + s
+        last = out; n += len(items)
+        for srow, (i, sgc) in enumerate(items):
+            if i % 997 == 0: kept[i] = (sgc, out[srow])
+    jax.block_until_ready(acc); jax.block_until_ready(last)
+    el = time.time()-t0
+    log(label, round(el,1), "n_sg", n, "G_auto", fwd._auto_col_group(len({s.off0 for s in sgs})))
+    return fwd, kept, float(acc[...,0] if acc.ndim else acc)
+fwd, kept, _ = run("COLD full forward (compile+upload+run)")
+_, kept, _ = run("WARM full forward")
+t0=time.time()
+rms = max(check_subgrid(config.image_size, sgc, config.core.as_complex(np.asarray(d)), sources)
+          for sgc, d in kept.values())
+log("rms over", len(kept), "samples:", f"{rms:.3e}", "(pull took", round(time.time()-t0,1), "s)")

@@ -1,0 +1,14 @@
+import time, numpy as np, jax, jax.numpy as jnp
+def log(*a): print(*a, file=open("/tmp/probe/log.txt","a"), flush=True)
+log("=== download probe")
+x = jnp.ones((64*1024*1024,), jnp.float32); jax.block_until_ready(x)  # 256MB flat
+t0=time.time(); h=np.asarray(x); log("flat 256MB", round(time.time()-t0,2), "->", round(h.nbytes/1e6/(time.time()-t0),1), "MB/s")
+y = jnp.ones((16*1024*1024,), jnp.float32); jax.block_until_ready(y)  # 64MB
+t0=time.time(); h=np.asarray(y); log("flat 64MB", round(time.time()-t0,2), "->", round(h.nbytes/1e6/(time.time()-t0),1), "MB/s")
+z = jnp.ones((8, 1024, 1024, 8, 2), jnp.float32); jax.block_until_ready(z)  # 512MB 5D
+t0=time.time(); h=np.asarray(z); log("5d 512MB", round(time.time()-t0,2), "->", round(h.nbytes/1e6/(time.time()-t0),1), "MB/s")
+t0=time.time(); h=jax.device_get(x); log("device_get flat 256MB", round(time.time()-t0,2))
+# chunked pulls of the flat array
+t0=time.time()
+parts=[np.asarray(x[i*8*1024*1024:(i+1)*8*1024*1024]) for i in range(8)]
+log("chunked 8x32MB", round(time.time()-t0,2))

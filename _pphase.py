@@ -1,0 +1,34 @@
+import os, time, numpy as np, jax, jax.numpy as jnp
+def log(*a): print(*a, file=open("/tmp/probe/phase.txt","a"), flush=True)
+log("=== phase timing 32k")
+from swiftly_tpu import SwiftlyConfig, SWIFT_CONFIGS, make_full_facet_cover, make_full_subgrid_cover
+from swiftly_tpu.parallel.streamed import (_facet_pass_sampled_j, _column_pass_fwd_j,
+                                            sampled_row_indices)
+params = dict(SWIFT_CONFIGS["32k[1]-n16k-512"]); params.setdefault("fov", 1.0)
+config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+core = config.core
+fcs = make_full_facet_cover(config); sgs = make_full_subgrid_cover(config)
+F, yB, m = 9, fcs[0].size, core.xM_yN_size
+col_offs0 = sorted({sg.off0 for sg in sgs}); S = sum(1 for sg in sgs if sg.off0==col_offs0[0])
+G = 4
+Fr = jnp.zeros((F, yB, yB), jnp.float32); Fi = jnp.zeros((F, yB, yB), jnp.float32)
+jax.block_until_ready(Fr)
+e0 = jnp.asarray((np.array([fc.off0 for fc in fcs]) - yB//2).astype(np.int32))
+krows = jnp.asarray(sampled_row_indices(core, col_offs0[:G]))
+samfn = _facet_pass_sampled_j(core)
+t0=time.time(); buf = samfn(Fr, Fi, e0, krows); jax.block_until_ready(buf)
+log("samfn cold(G=4)", round(time.time()-t0,1))
+for trial in range(2):
+    t0=time.time(); buf = samfn(Fr, Fi, e0, krows); jax.block_until_ready(buf)
+    log("samfn warm", round(time.time()-t0,2))
+colfn = _column_pass_fwd_j(core, sgs[0].size)
+NMBF = jax.lax.slice_in_dim(buf, 0, m, axis=1)
+foffs0 = jnp.asarray([fc.off0 for fc in fcs]); foffs1 = jnp.asarray([fc.off1 for fc in fcs])
+sg_offs = jnp.asarray([(col_offs0[0], s.off1) for s in sgs[:S]])
+m0 = jnp.ones((S, sgs[0].size), jnp.float32); m1 = jnp.ones((S, sgs[0].size), jnp.float32)
+t0=time.time(); out = colfn(NMBF, foffs0, foffs1, sg_offs, m0, m1); jax.block_until_ready(out)
+log("colfn cold", round(time.time()-t0,1))
+for trial in range(2):
+    t0=time.time(); out = colfn(NMBF, foffs0, foffs1, sg_offs, m0, m1); jax.block_until_ready(out)
+    log("colfn warm", round(time.time()-t0,2))
+log("implied total: samfn*19 + colfn*74")

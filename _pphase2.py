@@ -1,0 +1,48 @@
+import time, numpy as np, jax, jax.numpy as jnp
+def log(*a): print(*a, file=open("/tmp/probe/phase.txt","a"), flush=True)
+log("=== real-loop step timing 32k")
+from swiftly_tpu import (SwiftlyConfig, SWIFT_CONFIGS, make_full_facet_cover,
+                         make_full_subgrid_cover, make_facet)
+from swiftly_tpu.parallel.streamed import (_facet_pass_sampled_j, _column_pass_fwd_j,
+                                            sampled_row_indices, _to_host_layout)
+from swiftly_tpu.api import _subgrid_masks
+params = dict(SWIFT_CONFIGS["32k[1]-n16k-512"]); params.setdefault("fov", 1.0)
+config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+core = config.core
+fcs = make_full_facet_cover(config); sgs = make_full_subgrid_cover(config)
+sources = [(1.0, 1, 0)]
+f0 = _to_host_layout(core, make_facet(config.image_size, fcs[0], sources))
+m = core.xM_yN_size; yB = fcs[0].size
+t0=time.time()
+Fr = jnp.asarray(np.ascontiguousarray(np.stack([f0[...,0]]*9)))
+Fi = jnp.asarray(np.ascontiguousarray(np.stack([f0[...,1]]*9)))
+jax.block_until_ready(Fi); log("facet upload", round(time.time()-t0,1))
+e0 = jnp.asarray((np.array([fc.off0 for fc in fcs]) - yB//2).astype(np.int32))
+foffs0 = jnp.asarray([fc.off0 for fc in fcs]); foffs1 = jnp.asarray([fc.off1 for fc in fcs])
+col_offs0 = sorted({sg.off0 for sg in sgs})
+from collections import defaultdict
+groups = defaultdict(list)
+for sg in sgs: groups[sg.off0].append(sg)
+samfn = _facet_pass_sampled_j(core); colfn = _column_pass_fwd_j(core, sgs[0].size)
+G = 4
+for rep in range(3):
+    for g0 in range(0, 3*G, G):
+        grp = col_offs0[g0:g0+G]
+        t0=time.time()
+        krows = jnp.asarray(sampled_row_indices(core, grp)); jax.block_until_ready(krows)
+        t1=time.time()
+        buf = samfn(Fr, Fi, e0, krows); jax.block_until_ready(buf)
+        t2=time.time()
+        tcol=[]
+        for gi, off0 in enumerate(grp):
+            ta=time.time()
+            NMBF = jax.lax.slice_in_dim(buf, gi*m, (gi+1)*m, axis=1)
+            items = groups[off0]
+            sg_offs = jnp.asarray([(s.off0, s.off1) for s in items])
+            ms = [_subgrid_masks(s) for s in items]
+            out = colfn(NMBF, foffs0, foffs1, sg_offs,
+                        jnp.asarray(np.stack([x[0] for x in ms]), jnp.float32),
+                        jnp.asarray(np.stack([x[1] for x in ms]), jnp.float32))
+            s = jnp.sum(out*out); jax.block_until_ready(s)
+            tcol.append(round(time.time()-ta,2))
+        log(f"rep{rep} grp{g0//G}: krows {t1-t0:.2f} samfn {t2-t1:.2f} cols {tcol}")

@@ -1,0 +1,33 @@
+import time, numpy as np, jax, jax.numpy as jnp
+def log(*a): print(*a, file=open("/tmp/probe/log.txt","a"), flush=True)
+log("=== stage micro-probe 32k")
+from swiftly_tpu import SwiftlyConfig, SWIFT_CONFIGS
+from swiftly_tpu.parallel.streamed import _facet_pass_fwd_j
+params = dict(SWIFT_CONFIGS["32k[1]-n16k-512"]); params.setdefault("fov", 1.0)
+config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+core = config.core
+log("config ready")
+F, yB, Cb, K = 9, 11264, 512, 74
+block = jnp.zeros((F, yB, Cb, 2), dtype=jnp.float32)
+foffs0 = jnp.asarray(np.arange(F) * 11264 % 32768)
+col_offs0 = jnp.asarray(np.arange(K) * 448)
+fwd = _facet_pass_fwd_j(core)
+t0=time.time()
+lowered = fwd.lower(block, foffs0, col_offs0)
+log("lower", round(time.time()-t0,1))
+t0=time.time()
+compiled = lowered.compile()
+log("compile", round(time.time()-t0,1))
+try:
+    log("mem analysis:", compiled.memory_analysis())
+except Exception as e:
+    log("mem analysis failed", e)
+t0=time.time()
+out = compiled(block, foffs0, col_offs0); jax.block_until_ready(out)
+log("run1", round(time.time()-t0,1), out.shape)
+t0=time.time()
+out = compiled(block, foffs0, col_offs0); jax.block_until_ready(out)
+log("run2", round(time.time()-t0,1))
+t0=time.time()
+h = np.asarray(out)
+log("download", round(time.time()-t0,1), h.nbytes/1e6, "MB")

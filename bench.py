@@ -13,6 +13,10 @@ Prints ONE JSON line:
 Environment knobs:
   BENCH_CONFIG   catalogue key (default "4k[1]-n2k-512")
   BENCH_BASELINE_SAMPLES  numpy subgrids to time for the baseline (default 3)
+  BENCH_MODE     "batched" (default; whole cover as one fused program,
+                 prepared facets resident) or "streamed" (facets-resident
+                 sampled-DFT column groups — for configs whose prepared
+                 facet stack exceeds HBM, e.g. 32k on a 16 GiB chip)
 """
 
 import json
@@ -22,7 +26,7 @@ import time
 import numpy as np
 
 
-def _build(backend, params, dtype=None):
+def _build(backend, params, dtype=None, streamed=False):
     from swiftly_tpu import (
         SwiftlyConfig,
         SwiftlyForward,
@@ -39,14 +43,76 @@ def _build(backend, params, dtype=None):
         (fc, make_facet(config.image_size, fc, sources))
         for fc in facet_configs
     ]
-    fwd = SwiftlyForward(config, facet_tasks, lru_forward=2, queue_size=64)
+    if streamed:
+        from swiftly_tpu.parallel import StreamedForward
+
+        fwd = StreamedForward(config, facet_tasks, residency="device")
+    else:
+        fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=64)
     return config, fwd, subgrid_configs, sources
+
+
+def _numpy_baseline_from_parts(params, sources):
+    """Extrapolate the numpy forward wall-clock from sampled sub-ops.
+
+    At streamed-mode scales (32k+) a full numpy forward pass takes hours
+    on one core, so time its three cost centres on small samples and
+    scale linearly in op COUNTS (never in config size): facet preparation
+    per column block, per-column extraction+preparation, and per-subgrid
+    summation/finish.
+    """
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.ops import numpy_backend as npk
+    from swiftly_tpu.ops.core import prepare_facet_math
+    from swiftly_tpu.parallel import batched
+
+    config = SwiftlyConfig(backend="numpy", **params)
+    core = config.core
+    fcs = make_full_facet_cover(config)
+    sgs = make_full_subgrid_cover(config)
+    n_facets, yB = len(fcs), fcs[0].size
+    m, yN = core.xM_yN_size, core.yN_size
+    col_offs0 = sorted({sg.off0 for sg in sgs})
+    S = sum(1 for sg in sgs if sg.off0 == col_offs0[0])
+
+    facet = make_facet(config.image_size, fcs[0], sources)
+    blk = min(256, yB)
+    t0 = time.time()
+    prepare_facet_math(npk, core._Fb, yN, facet[:, :blk], fcs[0].off0, 0)
+    t_prepare = (time.time() - t0) * (yB / blk) * n_facets
+
+    BF_F = np.zeros((yN, yB), dtype=complex)
+    t0 = time.time()
+    col = core.extract_from_facet(BF_F, col_offs0[0], 0)
+    NMBF_BF = core.prepare_facet(col, fcs[0].off1, 1)
+    t_col = (time.time() - t0) * n_facets * len(col_offs0)
+
+    NMBF_BFs = np.zeros((n_facets, m, yN), dtype=complex)
+    offs0 = [fc.off0 for fc in fcs]
+    offs1 = [fc.off1 for fc in fcs]
+    sg = sgs[0]
+    t0 = time.time()
+    batched.subgrid_from_columns_batch(
+        core, NMBF_BFs, offs0, offs1, sg.off0, sg.off1, sg.size,
+        (np.ones(sg.size), np.ones(sg.size)),
+    )
+    t_sg = (time.time() - t0) * len(sgs)
+    return t_prepare + t_col + t_sg
 
 
 def main():
     import jax
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
 
     config_name = os.environ.get("BENCH_CONFIG", "4k[1]-n2k-512")
     n_baseline = int(os.environ.get("BENCH_BASELINE_SAMPLES", "3"))
@@ -55,44 +121,83 @@ def main():
 
     platform = jax.devices()[0].platform
     dtype = jax.numpy.float32
+    mode = os.environ.get("BENCH_MODE", "batched")
 
     # --- accelerated run (planar backend) --------------------------------
-    config, fwd, subgrid_configs, sources = _build("planar", params, dtype)
-
-    # Warmup: compile + run the fused whole-cover program once
-    jax.block_until_ready(fwd.all_subgrids(subgrid_configs))
-
-    # Timed: ONE dispatch (fused scan over columns), ONE host sync — the
-    # transform's real device wall-clock, not per-subgrid tunnel latency.
-    t0 = time.time()
-    results = fwd.all_subgrids(subgrid_configs)
-    jax.block_until_ready(results)
-    elapsed = time.time() - t0
-
-    # RMS vs oracle on a few sample subgrids
-    rms = max(
-        check_subgrid(
-            config.image_size, sg, config.core.as_complex(results[i]), sources
-        )
-        for i, sg in list(enumerate(subgrid_configs))[:: max(1, len(subgrid_configs) // 4)]
+    config, fwd, subgrid_configs, sources = _build(
+        "planar", params, dtype, streamed=(mode == "streamed")
     )
 
+    def run_streamed():
+        """Full cover via sampled-DFT column groups; outputs consumed on
+        device (device->host bandwidth is not part of the transform)."""
+        kept = {}
+        step = max(1, len(subgrid_configs) // 5)
+        for items, out in fwd.stream_columns(
+            subgrid_configs, device_arrays=True
+        ):
+            for srow, (i, sgc) in enumerate(items):
+                if i % step == 0:
+                    kept[i] = (sgc, out[srow])
+            last = out
+        jax.block_until_ready(last)
+        return kept
+
+    if mode == "streamed":
+        kept = run_streamed()  # warmup: compile + facet upload
+        t0 = time.time()
+        kept = run_streamed()
+        elapsed = time.time() - t0
+        rms = max(
+            check_subgrid(
+                config.image_size, sgc,
+                config.core.as_complex(np.asarray(d)), sources,
+            )
+            for sgc, d in kept.values()
+        )
+    else:
+        # Warmup: compile + run the fused whole-cover program once
+        jax.block_until_ready(fwd.all_subgrids(subgrid_configs))
+
+        # Timed: ONE dispatch (fused scan over columns), ONE host sync —
+        # the transform's real device wall-clock, not per-subgrid tunnel
+        # latency.
+        t0 = time.time()
+        results = fwd.all_subgrids(subgrid_configs)
+        jax.block_until_ready(results)
+        elapsed = time.time() - t0
+
+        # RMS vs oracle on a few sample subgrids
+        rms = max(
+            check_subgrid(
+                config.image_size, sg, config.core.as_complex(results[i]),
+                sources,
+            )
+            for i, sg in list(enumerate(subgrid_configs))[
+                :: max(1, len(subgrid_configs) // 4)
+            ]
+        )
+
     # --- numpy reference baseline (sample-extrapolated) ------------------
-    # Warm one subgrid first so the one-time facet preparation is excluded
-    # from the per-subgrid sample, exactly as the planar run's warmup does.
-    _, fwd_np, sg_np, _ = _build("numpy", params)
-    fwd_np.get_subgrid_task(sg_np[0])
-    t0 = time.time()
-    for sg in sg_np[1 : 1 + n_baseline]:
-        fwd_np.get_subgrid_task(sg)
-    numpy_total = (time.time() - t0) / n_baseline * len(sg_np)
+    if mode == "streamed":
+        numpy_total = _numpy_baseline_from_parts(params, sources)
+    else:
+        # Warm one subgrid first so the one-time facet preparation is
+        # excluded from the per-subgrid sample, exactly as the planar
+        # run's warmup does.
+        _, fwd_np, sg_np, _ = _build("numpy", params)
+        fwd_np.get_subgrid_task(sg_np[0])
+        t0 = time.time()
+        for sg in sg_np[1 : 1 + n_baseline]:
+            fwd_np.get_subgrid_task(sg)
+        numpy_total = (time.time() - t0) / n_baseline * len(sg_np)
 
     print(
         json.dumps(
             {
                 "metric": f"{config_name} forward facet->subgrid wall-clock "
                           f"({len(subgrid_configs)} subgrids, planar f32, "
-                          f"{platform})",
+                          f"{mode}, {platform})",
                 "value": round(elapsed, 4),
                 "unit": "s",
                 "vs_baseline": round(numpy_total / elapsed, 2),

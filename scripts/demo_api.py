@@ -69,21 +69,47 @@ def demo_api(args, params):
     ]
     log.info("facet data built in %.2fs", time.time() - t0)
 
-    fwd = SwiftlyForward(config, facet_tasks, args.lru_forward,
-                         args.queue_size)
-    bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
-                          args.queue_size)
+    streamed = args.execution.startswith("streamed")
+    if streamed:
+        from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+
+        residency = (
+            "device" if args.execution == "streamed-device" else "host"
+        )
+        fwd = StreamedForward(
+            config, facet_tasks, residency=residency,
+            col_group=args.col_group or None,
+        )
+        bwd = StreamedBackward(config, facet_configs)
+    else:
+        fwd = SwiftlyForward(config, facet_tasks, args.lru_forward,
+                             args.queue_size)
+        bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
+                              args.queue_size)
 
     t0 = time.time()
     with trace(args.profile_dir):
-        for i, sg_config in enumerate(subgrid_configs):
-            subgrid = fwd.get_subgrid_task(sg_config)
-            # identity "processing" step sits here in a real pipeline
-            bwd.add_new_subgrid_task(sg_config, subgrid)
-            if i % 50 == 0:
-                log.info("subgrid %d/%d off0=%d off1=%d", i,
-                         len(subgrid_configs), sg_config.off0, sg_config.off1)
-        facets = bwd.finish()
+        if streamed:
+            done = 0
+            for items, subgrids in fwd.stream_columns(subgrid_configs):
+                # identity "processing" step sits here in a real pipeline
+                bwd.add_subgrids(
+                    [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+                )
+                done += len(items)
+                log.info("column done: %d/%d subgrids", done,
+                         len(subgrid_configs))
+            facets = bwd.finish()
+        else:
+            for i, sg_config in enumerate(subgrid_configs):
+                subgrid = fwd.get_subgrid_task(sg_config)
+                # identity "processing" step sits here in a real pipeline
+                bwd.add_new_subgrid_task(sg_config, subgrid)
+                if i % 50 == 0:
+                    log.info("subgrid %d/%d off0=%d off1=%d", i,
+                             len(subgrid_configs), sg_config.off0,
+                             sg_config.off1)
+            facets = bwd.finish()
         facets_np = [config.core.as_complex(f) for f in facets]
     elapsed = time.time() - t0
     log.info("forward+backward round trip: %.2fs (%.3fs/subgrid)",

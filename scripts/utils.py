@@ -76,6 +76,25 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
         help="backward column accumulator count",
     )
     parser.add_argument(
+        "--execution",
+        type=str,
+        default="batched",
+        choices=["batched", "streamed", "streamed-device"],
+        help="execution strategy: 'batched' keeps prepared facets "
+             "device-resident (fastest when they fit HBM); 'streamed' "
+             "buffers column intermediates in host RAM (out-of-core); "
+             "'streamed-device' keeps raw facets resident and computes "
+             "column groups by sampled DFT (large N on one chip, no "
+             "host round-trip)",
+    )
+    parser.add_argument(
+        "--col_group",
+        type=int,
+        default=0,
+        help="streamed-device: columns per sampled-DFT group "
+             "(0 = auto-size from the HBM budget)",
+    )
+    parser.add_argument(
         "--mesh_devices",
         type=_mesh_devices_arg,
         default="0",
@@ -106,6 +125,9 @@ def setup_jax(args):
     """
     import jax
 
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     if getattr(args, "multihost", False):
         from swiftly_tpu.parallel.mesh import initialize_multihost
 

@@ -210,15 +210,108 @@ def _facet_pass_bwd_j(core, facet_size):
     return _jit(static=())(fn)
 
 
+# -- sampled-DFT facet pass -------------------------------------------------
+#
+# The forward facet pass per output row r of subgrid column offset sigma is
+# a LINEAR map of the facet column f[j] (j < yB):
+#
+#   NMBF[r] = roll(wrapped_extract(ifft(wrapped_embed(Fb*f, yN, delta)),
+#                                  m, s), s)[r]
+#           = (1/yN) sum_j Fb[j] f[j] w^{(e0 + j) * kt_r},  w = e^{+2pi i/yN}
+#
+# with s = sigma*yN/N, kt_r = ((yN//2 - m//2 + s + ((r - s) mod m)) mod yN)
+# - yN//2 the extracted spectral row index and e0 = delta - yB//2 the
+# embedding shift (wrapped_embed start yN//2 - yB//2 + delta, minus the
+# ifft centre yN//2). The phase separates: w^{e0*kt} (per facet, per row)
+# times w^{j*kt} (facet-independent). So the WHOLE pass for any set of
+# output rows is one complex matmul against A[r, j] = Fb[j]/yN * w^{j*kt_r}
+# plus a per-facet diagonal phase — compute scales with rows actually
+# needed, which makes column-group chunking free (no FFT recompute), and
+# the FLOPs land on the MXU as a single large einsum.
+
+
+def sampled_row_indices(core, col_offs0):
+    """Centred spectral row indices kt [G*m] for a group of subgrid
+    column offsets (int32; validated against the FFT-based pass by tests).
+    """
+    m = core.xM_yN_size
+    yN = core.yN_size
+    r = np.arange(m)
+    rows = []
+    for off0 in col_offs0:
+        s = int(off0) * yN // core.N
+        k = (yN // 2 - m // 2 + s + ((r - s) % m)) % yN
+        rows.append(k - yN // 2)
+    return np.concatenate(rows).astype(np.int32)
+
+
 @functools.lru_cache(maxsize=None)
-def _scatter_block_j(core):
-    """Write a [K, F, m, Cb] block into the device NMBF buffer in place."""
+def _facet_pass_sampled_j(core):
+    """facets [F, yB, Y(,2)] -> sampled contribution rows [F, R, Y(,2)].
 
-    def fn(buf, block, j0):
-        start = (0, 0, 0, j0) + (0,) * len(_tail(core))
-        return jax.lax.dynamic_update_slice(buf, block, start)
+    `krows` are centred spectral indices (from `sampled_row_indices`),
+    `e0` the per-facet embedding shifts (facet_off0 - yB//2). One einsum
+    per call; works for the full column set or any chunk of it.
+    """
+    import jax.numpy as jnp
 
-    return _jit(donate=(0,))(fn)
+    yN = core.yN_size
+
+    def phases(prod_int):
+        theta = (2 * np.pi / yN) * jnp.mod(prod_int, yN)
+        return jnp.cos(theta), jnp.sin(theta)
+
+    if _planar(core):
+        # Planes arrive as SEPARATE arrays (Fr, Fi), not a trailing axis:
+        # slicing a stacked [F, yB, yB, 2] inside the program would
+        # materialise multi-GiB plane copies next to the resident stack.
+
+        def fn(Fr, Fi, e0, krows):
+            yB = Fr.shape[1]
+            dt = Fr.dtype
+            fb = core._p.extract_mid(core._Fb, yB, 0) / yN  # [yB] real
+            j = jnp.arange(yB, dtype=jnp.int32)
+            a_cos, a_sin = phases(jnp.outer(krows, j))  # [R, yB]
+            A_re = (a_cos * fb[None, :]).astype(dt)
+            A_im = (a_sin * fb[None, :]).astype(dt)
+            from ..ops.planar_backend import _PRECISION
+
+            f = lambda a, b: jnp.einsum(
+                "rj,fjc->frc", a, b, precision=_PRECISION
+            )
+            out_re = f(A_re, Fr) - f(A_im, Fi)
+            out_im = f(A_re, Fi) + f(A_im, Fr)
+            p_cos, p_sin = phases(
+                e0.astype(jnp.int32)[:, None] * krows[None, :]
+            )  # [F, R]
+            p_cos = p_cos.astype(dt)[..., None]
+            p_sin = p_sin.astype(dt)[..., None]
+            return jnp.stack(
+                [
+                    out_re * p_cos - out_im * p_sin,
+                    out_re * p_sin + out_im * p_cos,
+                ],
+                axis=-1,
+            )
+
+    else:
+
+        def fn(facets, e0, krows):
+            yB = facets.shape[1]
+            fb = core._p.extract_mid(core._Fb, yB, 0) / yN
+            j = jnp.arange(yB, dtype=jnp.int32)
+            a_cos, a_sin = phases(jnp.outer(krows, j))
+            A = (a_cos + 1j * a_sin).astype(core.dtype) * fb[None, :]
+            out = jnp.einsum("rj,fjc->frc", A, facets)
+            p_cos, p_sin = phases(
+                e0.astype(jnp.int32)[:, None] * krows[None, :]
+            )
+            phi = (p_cos + 1j * p_sin).astype(core.dtype)
+            return out * phi[..., None]
+
+    return _jit()(fn)
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -250,16 +343,9 @@ class _StreamedBase:
         self._foffs0 = jnp.asarray(self.stack.offs0)
         self._foffs1 = jnp.asarray(self.stack.offs1)
 
-    def _buffer_shape(self, n_cols):
-        F, m, yB = len(self.stack), self.core.xM_yN_size, self._yB_pad
-        return (n_cols, F, m, yB) + _tail(self.core)
-
     def _alloc_buffer(self, n_cols):
-        shape = self._buffer_shape(n_cols)
-        if self.residency == "device":
-            import jax.numpy as jnp
-
-            return jnp.zeros(shape, dtype=self.core.dtype)
+        F, m, yB = len(self.stack), self.core.xM_yN_size, self._yB_pad
+        shape = (n_cols, F, m, yB) + _tail(self.core)
         return np.zeros(shape, dtype=_np_dtype(self.core))
 
 
@@ -298,7 +384,7 @@ class StreamedForward:
     """
 
     def __init__(self, swiftly_config, facet_tasks, col_block=512,
-                 residency="host"):
+                 residency="host", col_group=None):
         self._base = _StreamedBase(
             swiftly_config, [cfg for cfg, _ in facet_tasks], col_block,
             residency,
@@ -310,6 +396,8 @@ class StreamedForward:
         self._facet_data = [
             _to_host_layout(core, d) for _, d in facet_tasks
         ]
+        self.col_group = col_group
+        self._dev_facets = None
         self._nmbf = None
         self._col_index = None
 
@@ -328,7 +416,6 @@ class StreamedForward:
         return block
 
     def _build_nmbf(self, col_offs0):
-        import jax
         import jax.numpy as jnp
 
         base = self._base
@@ -342,13 +429,10 @@ class StreamedForward:
             out = fwd(
                 jnp.asarray(self._facet_block(j0)), base._foffs0, col_offs0_j
             )
-            if base.residency == "device":
-                buf = _scatter_block_j(core)(buf, out, j0)
-            else:
-                pending.append((j0, out))
-                if len(pending) > 1:
-                    pj, pout = pending.pop(0)
-                    buf[:, :, :, pj : pj + Cb] = np.asarray(pout)
+            pending.append((j0, out))
+            if len(pending) > 1:
+                pj, pout = pending.pop(0)
+                buf[:, :, :, pj : pj + Cb] = np.asarray(pout)
         for pj, pout in pending:
             buf[:, :, :, pj : pj + Cb] = np.asarray(pout)
         self._nmbf = buf
@@ -359,55 +443,163 @@ class StreamedForward:
         import jax.numpy as jnp
 
         yB = self._base.stack.size
-        col = self._nmbf[k][:, :, :yB]
-        if self._base.residency == "device":
-            return col
-        return jnp.asarray(col)
+        return jnp.asarray(self._nmbf[k][:, :, :yB])
 
     # -- column pass -------------------------------------------------------
 
-    def stream_columns(self, subgrid_configs):
-        """Yield (col_items, subgrids) per column; one device program each.
-
-        `col_items` is the column's [(input_index, SubgridConfig), ...];
-        `subgrids` the matching stacked host array [S, xA, xA(,2)].
-        """
+    def _column_program(self, colfn, NMBF, items):
         from ..api import _subgrid_masks
 
         import jax.numpy as jnp
 
         base = self._base
         core = base.core
-        groups = _group_full_columns(subgrid_configs)
-        col_offs0 = list(groups)
-        if self._nmbf is None or any(
-            int(o) not in self._col_index for o in col_offs0
-        ):
-            self._build_nmbf(col_offs0)
-        size = subgrid_configs[0].size
-        colfn = _column_pass_fwd_j(core, size)
         rdt = core._Fb.dtype
+        sg_offs = jnp.asarray([(sg.off0, sg.off1) for _, sg in items])
+        ms = [_subgrid_masks(sg) for _, sg in items]
+        return colfn(
+            NMBF,
+            base._foffs0,
+            base._foffs1,
+            sg_offs,
+            jnp.asarray(np.stack([m[0] for m in ms]), rdt),
+            jnp.asarray(np.stack([m[1] for m in ms]), rdt),
+        )
+
+    def stream_columns(self, subgrid_configs, device_arrays=False):
+        """Yield (col_items, subgrids) per column; one device program each.
+
+        `col_items` is the column's [(input_index, SubgridConfig), ...];
+        `subgrids` the matching stacked [S, xA, xA(,2)] — a host array by
+        default, or the raw device array with `device_arrays=True` (for
+        on-device consumers: device->host bandwidth may be the bottleneck
+        on remote-attached TPUs).
+        """
+        subgrid_configs = list(subgrid_configs)
+        groups = _group_full_columns(subgrid_configs)
+        size = subgrid_configs[0].size
+        colfn = _column_pass_fwd_j(self.core, size)
+        if self._base.residency == "device":
+            gen = self._device_columns(groups, colfn)
+        else:
+            gen = self._host_columns(groups, colfn)
+        if device_arrays:
+            yield from gen
+            return
         pending = []
-        for off0 in col_offs0:
-            items = groups[off0]
-            sg_offs = jnp.asarray(
-                [(sg.off0, sg.off1) for _, sg in items]
-            )
-            ms = [_subgrid_masks(sg) for _, sg in items]
-            out = colfn(
-                self._nmbf_column(self._col_index[int(off0)]),
-                base._foffs0,
-                base._foffs1,
-                sg_offs,
-                jnp.asarray(np.stack([m[0] for m in ms]), rdt),
-                jnp.asarray(np.stack([m[1] for m in ms]), rdt),
-            )
+        for items, out in gen:
             pending.append((items, out))
             if len(pending) > 1:
                 pitems, pout = pending.pop(0)
                 yield pitems, np.asarray(pout)
         for pitems, pout in pending:
             yield pitems, np.asarray(pout)
+
+    def _host_columns(self, groups, colfn):
+        """Host-buffered NMBF_all: FFT facet pass + per-column upload."""
+        col_offs0 = list(groups)
+        if self._nmbf is None or any(
+            int(o) not in self._col_index for o in col_offs0
+        ):
+            self._build_nmbf(col_offs0)
+        for off0 in col_offs0:
+            items = groups[off0]
+            NMBF = self._nmbf_column(self._col_index[int(off0)])
+            yield items, self._column_program(colfn, NMBF, items)
+
+    def _device_columns(self, groups, colfn):
+        """Facets-resident sampled-DFT pass in column groups.
+
+        Facets upload ONCE and stay on device; each group of G columns'
+        contribution rows is one einsum dispatch (compute proportional to
+        the rows extracted, so chunking is free); nothing round-trips
+        through the host. Device residency = facets + one [F, G*m, yB]
+        group buffer.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        base = self._base
+        core = base.core
+        m = core.xM_yN_size
+        yB = base.stack.size
+        n_pad = base.stack.n_total - base.stack.n_real
+        if self._dev_facets is None:
+            if _planar(core):
+                # upload re/im planes as separate contiguous arrays (the
+                # sampled program must not slice them out of a stacked
+                # array — that would copy the multi-GiB stack)
+                planes = []
+                for p in (0, 1):
+                    host = np.ascontiguousarray(
+                        np.stack(
+                            [d[..., p] for d in self._facet_data]
+                            + [np.zeros_like(self._facet_data[0][..., p])]
+                            * n_pad
+                        )
+                    )
+                    planes.append(jnp.asarray(host))
+                self._dev_facets = tuple(planes)
+            else:
+                self._dev_facets = (
+                    jnp.stack(
+                        [jnp.asarray(d) for d in self._facet_data]
+                        + [jnp.zeros_like(jnp.asarray(self._facet_data[0]))]
+                        * n_pad
+                    ),
+                )
+        e0 = jnp.asarray(
+            (base.stack.offs0 - yB // 2).astype(np.int32)
+        )
+        col_offs0 = list(groups)
+        G = self.col_group or self._auto_col_group(len(col_offs0))
+        samfn = _facet_pass_sampled_j(core)
+        prev_tail = None  # backpressure marker: last column of group g-1
+        for g0 in range(0, len(col_offs0), G):
+            grp = col_offs0[g0 : g0 + G]
+            # pad a short final group to the full G (row indices repeat the
+            # last column; its outputs are skipped below) — a smaller krows
+            # shape would trigger a full recompile of the sampled program
+            grp_padded = grp + [grp[-1]] * (G - len(grp))
+            krows = jnp.asarray(sampled_row_indices(core, grp_padded))
+            # JAX dispatch is asynchronous: without a wait the host loop
+            # races ahead and every group buffer stays live at once
+            # (OOM). Blocking on the previous group's tail bounds the
+            # in-flight set to two group buffers.
+            if prev_tail is not None:
+                jax.block_until_ready(prev_tail)
+            buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
+            for gi, off0 in enumerate(grp):
+                NMBF = jax.lax.slice_in_dim(
+                    buf, gi * m, (gi + 1) * m, axis=1
+                )
+                out = self._column_program(colfn, NMBF, groups[off0])
+                prev_tail = out
+                yield groups[off0], out
+
+    def _auto_col_group(self, n_cols):
+        """Largest column-group whose buffer + transients fit the budget.
+
+        HBM budget via SWIFTLY_HBM_BUDGET (bytes, default 14e9); on CPU
+        the full column set is one group.
+        """
+        import os
+
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            return n_cols
+        core = self.core
+        base = self._base
+        dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
+        yB = base.stack.size
+        F = len(base.stack)
+        budget = float(os.environ.get("SWIFTLY_HBM_BUDGET", 14e9))
+        facets_b = F * yB * yB * dsize
+        reserve = 3e9  # column-pass workspace + trig transients
+        col_b = 2 * F * core.xM_yN_size * yB * dsize  # buffer + A matrix
+        G = int((budget - facets_b - reserve) // col_b)
+        return max(1, min(n_cols, G))
 
     def all_subgrids(self, subgrid_configs):
         """Every subgrid, in request order, as one host array [n, xA, xA]."""

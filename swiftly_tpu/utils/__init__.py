@@ -1,5 +1,6 @@
-"""Utilities: profiling, memory accounting, logging."""
+"""Utilities: profiling, memory accounting, compilation cache, logging."""
 
+from .cache import enable_compilation_cache
 from .profiling import (
     MemorySampler,
     collective_bytes_backward,
@@ -13,5 +14,6 @@ __all__ = [
     "collective_bytes_backward",
     "collective_bytes_forward",
     "device_memory_stats",
+    "enable_compilation_cache",
     "trace",
 ]

@@ -120,6 +120,20 @@ def test_streamed_backward_order_independent():
     np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize("col_group", [1, 2])
+def test_streamed_device_group_chunking(backend, col_group):
+    """Sampled-pass column groups produce identical results to one group."""
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    ref = StreamedForward(
+        config, facet_tasks, residency="device"
+    ).all_subgrids(subgrid_configs)
+    out = StreamedForward(
+        config, facet_tasks, residency="device", col_group=col_group
+    ).all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
 def test_streamed_requires_device_backend():
     config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
     facet_configs = make_full_facet_cover(config)
