@@ -1,0 +1,26 @@
+# Runtime image for swiftly-tpu (parity: reference Dockerfile, two-stage
+# python slim). The default image targets CPU execution (tests, small
+# configs); for TPU VMs install jax[tpu] instead of jax.
+
+FROM python:3.11-slim AS build
+
+WORKDIR /app
+COPY pyproject.toml ./
+COPY swiftly_tpu ./swiftly_tpu
+COPY scripts ./scripts
+COPY bench.py ./
+RUN pip install --no-cache-dir --prefix=/install .
+
+FROM python:3.11-slim
+
+COPY --from=build /install /usr/local
+COPY scripts /app/scripts
+COPY bench.py /app/bench.py
+WORKDIR /app
+
+# CPU-mesh defaults so multi-device code paths work out of the box
+ENV JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+ENTRYPOINT ["python", "scripts/demo_api.py"]
+CMD ["--swift_config", "1k[1]-n512-256"]

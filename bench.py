@@ -1,27 +1,38 @@
 """Benchmark: streaming facet->subgrid forward transform throughput.
 
-Runs the full forward pass (every subgrid of the cover) for a catalogue
-configuration on the available accelerator with the TPU-native planar
-backend, checks RMS vs the direct-DFT oracle on sample subgrids, and
-compares wall-clock against the numpy reference backend (same machine,
-sample-extrapolated).
+Runs the full forward pass (every subgrid of the cover) for one or more
+catalogue configurations on the available accelerator with the TPU-native
+planar backend, checks RMS vs the direct-DFT oracle on sample subgrids,
+and reports:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": <seconds>, "unit": "s",
-   "vs_baseline": <numpy_time / this_time>, ...extras}
+* wall-clock for the whole cover,
+* vs_baseline — ratio against the numpy reference backend on the same
+  machine (measured on small configs, sample-extrapolated on large ones —
+  see `baseline_estimated`),
+* tflops / mfu_pct — analytic FLOP count of the matmul-FFT pipeline
+  (exact: every op is an einsum of known shape, `swiftly_tpu.utils.flops`)
+  divided by wall-clock, and as % of the chip's published peak.
+
+Prints ONE JSON line per configuration; the LAST line is the headline
+metric (the north-star large-N config).
 
 Environment knobs:
-  BENCH_CONFIG   catalogue key (default "4k[1]-n2k-512")
+  BENCH_CONFIGS  comma-separated "name:mode" entries (mode batched|streamed;
+                 default "4k[1]-n2k-512:batched,32k[1]-n16k-512:streamed")
+  BENCH_CONFIG / BENCH_MODE  legacy single-config override
   BENCH_BASELINE_SAMPLES  numpy subgrids to time for the baseline (default 3)
-  BENCH_MODE     "batched" (default; whole cover as one fused program,
-                 prepared facets resident) or "streamed" (facets-resident
-                 sampled-DFT column groups — for configs whose prepared
-                 facet stack exceeds HBM, e.g. 32k on a 16 GiB chip)
+
+Modes: "batched" keeps the prepared facet stack resident and runs the
+whole cover as one fused program; "streamed" uses the facets-resident
+sampled-DFT column groups (for configs whose prepared facet stack exceeds
+HBM, e.g. 32k+ on a 16 GiB chip).
 """
 
 import json
 import os
+import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -50,7 +61,7 @@ def _build(backend, params, dtype=None, streamed=False):
     else:
         fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
                              queue_size=64)
-    return config, fwd, subgrid_configs, sources
+    return config, fwd, facet_configs, subgrid_configs, sources
 
 
 def _numpy_baseline_from_parts(params, sources):
@@ -79,7 +90,6 @@ def _numpy_baseline_from_parts(params, sources):
     n_facets, yB = len(fcs), fcs[0].size
     m, yN = core.xM_yN_size, core.yN_size
     col_offs0 = sorted({sg.off0 for sg in sgs})
-    S = sum(1 for sg in sgs if sg.off0 == col_offs0[0])
 
     facet = make_facet(config.image_size, fcs[0], sources)
     blk = min(256, yB)
@@ -106,25 +116,45 @@ def _numpy_baseline_from_parts(params, sources):
     return t_prepare + t_col + t_sg
 
 
-def main():
+def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed):
+    """Analytic FLOP count -> tflops / mfu_pct fields."""
+    from swiftly_tpu.utils.flops import (
+        forward_batched_flops,
+        forward_sampled_flops,
+        peak_tflops,
+    )
+
+    core = config.core
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    per_col = len(subgrid_configs) // n_cols
+    fn = forward_sampled_flops if mode == "streamed" else forward_batched_flops
+    flops = fn(
+        core,
+        n_facets=len(facet_configs),
+        facet_size=facet_configs[0].size,
+        n_columns=n_cols,
+        subgrids_per_column=per_col,
+        subgrid_size=subgrid_configs[0].size,
+    )
+    fields = {"tflops": round(flops / elapsed / 1e12, 2)}
+    peak = peak_tflops()
+    if peak:
+        fields["mfu_pct"] = round(100 * flops / elapsed / 1e12 / peak, 1)
+    return fields
+
+
+def run_one(config_name, mode, n_baseline):
     import jax
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
-    from swiftly_tpu.utils import enable_compilation_cache
 
-    enable_compilation_cache()
-
-    config_name = os.environ.get("BENCH_CONFIG", "4k[1]-n2k-512")
-    n_baseline = int(os.environ.get("BENCH_BASELINE_SAMPLES", "3"))
     params = dict(SWIFT_CONFIGS[config_name])
     params.setdefault("fov", 1.0)
-
     platform = jax.devices()[0].platform
     dtype = jax.numpy.float32
-    mode = os.environ.get("BENCH_MODE", "batched")
 
     # --- accelerated run (planar backend) --------------------------------
-    config, fwd, subgrid_configs, sources = _build(
+    config, fwd, facet_configs, subgrid_configs, sources = _build(
         "planar", params, dtype, streamed=(mode == "streamed")
     )
 
@@ -178,35 +208,73 @@ def main():
             ]
         )
 
-    # --- numpy reference baseline (sample-extrapolated) ------------------
-    if mode == "streamed":
+    # --- numpy reference baseline ----------------------------------------
+    baseline_estimated = mode == "streamed"
+    if baseline_estimated:
         numpy_total = _numpy_baseline_from_parts(params, sources)
     else:
         # Warm one subgrid first so the one-time facet preparation is
         # excluded from the per-subgrid sample, exactly as the planar
         # run's warmup does.
-        _, fwd_np, sg_np, _ = _build("numpy", params)
+        _, fwd_np, _, sg_np, _ = _build("numpy", params)
         fwd_np.get_subgrid_task(sg_np[0])
         t0 = time.time()
         for sg in sg_np[1 : 1 + n_baseline]:
             fwd_np.get_subgrid_task(sg)
         numpy_total = (time.time() - t0) / n_baseline * len(sg_np)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"{config_name} forward facet->subgrid wall-clock "
-                          f"({len(subgrid_configs)} subgrids, planar f32, "
-                          f"{mode}, {platform})",
-                "value": round(elapsed, 4),
-                "unit": "s",
-                "vs_baseline": round(numpy_total / elapsed, 2),
-                "rms_vs_dft_oracle": float(f"{rms:.3e}"),
-                "numpy_baseline_s": round(numpy_total, 2),
-                "n_subgrids": len(subgrid_configs),
-            }
-        )
+    result = {
+        "metric": f"{config_name} forward facet->subgrid wall-clock "
+                  f"({len(subgrid_configs)} subgrids, planar f32, "
+                  f"{mode}, {platform})",
+        "value": round(elapsed, 4),
+        "unit": "s",
+        "vs_baseline": round(numpy_total / elapsed, 2),
+        "rms_vs_dft_oracle": float(f"{rms:.3e}"),
+        "numpy_baseline_s": round(numpy_total, 2),
+        "baseline_estimated": baseline_estimated,
+        "n_subgrids": len(subgrid_configs),
+    }
+    result.update(
+        _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed)
     )
+    return result
+
+
+def main():
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    legacy = os.environ.get("BENCH_CONFIG")
+    if legacy:
+        entries = [(legacy, os.environ.get("BENCH_MODE", "batched"))]
+    else:
+        spec = os.environ.get(
+            "BENCH_CONFIGS",
+            "4k[1]-n2k-512:batched,32k[1]-n16k-512:streamed",
+        )
+        entries = []
+        for item in spec.split(","):
+            name, _, mode = item.strip().partition(":")
+            entries.append((name, mode or "batched"))
+    n_baseline = int(os.environ.get("BENCH_BASELINE_SAMPLES", "3"))
+
+    ok = []
+    for name, mode in entries:
+        try:
+            print(json.dumps(run_one(name, mode, n_baseline)), flush=True)
+            ok.append(True)
+        except Exception:  # pragma: no cover - report and move on
+            ok.append(False)
+            traceback.print_exc(file=sys.stderr)
+            print(
+                json.dumps({"metric": f"{name} ({mode})", "error": "failed"}),
+                flush=True,
+            )
+    # The LAST entry is the headline metric: its failure is a bench
+    # failure even if earlier configs passed.
+    sys.exit(0 if ok and ok[-1] else 1)
 
 
 if __name__ == "__main__":

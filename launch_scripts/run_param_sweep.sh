@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Parameter sweep over working-set knobs, one demo run per combination —
+# the TPU-pod equivalent of the reference's queue-size sweep
+# (reference slurm_scripts/submit_multi_queue_csd3.sh, which sweeps
+# --queue_size 1..10000 over the Dask cluster).
+#
+# Usage:
+#   ./run_param_sweep.sh [CONFIG] [ARTIFACT_ROOT]
+#
+# Sweeps:
+#   batched:   queue_size x lru_forward/backward
+#   streamed:  col_group (sampled-DFT group size; 0 = auto HBM budget)
+#
+# Each run writes its memory CSV + summary JSON under
+#   $ARTIFACT_ROOT/<execution>-<knob>/
+# so the sweep results are directly comparable (reference writes one
+# transfer-info line per queue size).
+
+set -euo pipefail
+
+CONFIG="${1:-4k[1]-n2k-512}"
+ROOT="${2:-sweep_artifacts}"
+cd "$(dirname "$0")/.."
+
+for queue in 16 64 256; do
+  for lru in 1 4; do
+    out="$ROOT/batched-q${queue}-l${lru}"
+    echo "=== batched queue_size=$queue lru=$lru -> $out"
+    python scripts/demo_api.py \
+      --swift_config "$CONFIG" --backend planar --precision f32 \
+      --execution batched --queue_size "$queue" \
+      --lru_forward "$lru" --lru_backward "$lru" \
+      --artifact_dir "$out"
+  done
+done
+
+for group in 0 1 4 16; do
+  out="$ROOT/streamed-device-g${group}"
+  echo "=== streamed-device col_group=$group -> $out"
+  python scripts/demo_api.py \
+    --swift_config "$CONFIG" --backend planar --precision f32 \
+    --execution streamed-device --col_group "$group" \
+    --artifact_dir "$out"
+done
+
+echo "sweep complete; summaries:"
+find "$ROOT" -name 'summary_*.json' -exec sh -c \
+  'python - "$1" <<"EOF"
+import json, sys
+s = json.load(open(sys.argv[1]))
+print(f"{sys.argv[1]}: {s[\"elapsed_s\"]}s, max RMS {s[\"max_facet_rms\"]:.2e}")
+EOF' _ {} \;

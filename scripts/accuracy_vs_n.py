@@ -1,0 +1,137 @@
+"""Measure planar-f32 forward accuracy vs image size N.
+
+The north-star configs run planar float32 on TPU; this script
+substantiates how the matmul-FFT pipeline's error grows with N (the
+four-step factored FFT and the sampled-DFT facet pass accumulate over
+progressively longer contractions). For each config it computes sample
+subgrids of the full cover and reports RMS vs the direct-DFT oracle, both
+absolute and RELATIVE (absolute RMS scales as 1/N² for a unit source, so
+only the relative number is comparable across N).
+
+Usage:
+    python scripts/accuracy_vs_n.py [--configs 1k[1]-n512-256,...]
+        [--mode auto|batched|streamed] [--json out.json]
+
+Writes one table row per config; paste into docs/accuracy.md.
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DEFAULT_CONFIGS = [
+    "1k[1]-n512-256",
+    "4k[1]-n2k-512",
+    "8k[1]-n4k-512",
+    "16k[1]-n8k-512",
+    "32k[1]-n16k-512",
+]
+
+# Prepared facet stack exceeds HBM above this N: use the streamed
+# (sampled-DFT, facets-resident) executor there, matching the bench.
+STREAMED_ABOVE = 8192
+
+
+def measure(config_name, mode, n_samples=16):
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu import (
+        SWIFT_CONFIGS,
+        SwiftlyConfig,
+        SwiftlyForward,
+        check_subgrid,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.parallel import StreamedForward
+
+    params = dict(SWIFT_CONFIGS[config_name])
+    params.setdefault("fov", 1.0)
+    config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+    N = config.image_size
+    if mode == "auto":
+        mode = "streamed" if N > STREAMED_ABOVE else "batched"
+
+    sources = [(1.0, 1, 0)]
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(N, fc, sources)) for fc in facet_configs
+    ]
+
+    t0 = time.time()
+    errs = []
+    if mode == "streamed":
+        fwd = StreamedForward(config, facet_tasks, residency="device")
+        step = max(1, len(subgrid_configs) // n_samples)
+        for items, out in fwd.stream_columns(
+            subgrid_configs, device_arrays=True
+        ):
+            for srow, (i, sgc) in enumerate(items):
+                if i % step == 0:
+                    errs.append(
+                        check_subgrid(
+                            N, sgc,
+                            config.core.as_complex(np.asarray(out[srow])),
+                            sources,
+                        )
+                    )
+    else:
+        fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=64)
+        step = max(1, len(subgrid_configs) // n_samples)
+        picked = subgrid_configs[::step]
+        tasks = fwd.get_subgrid_tasks(picked)
+        errs = [
+            check_subgrid(N, sg, config.core.as_complex(t), sources)
+            for sg, t in zip(picked, tasks)
+        ]
+    elapsed = time.time() - t0
+    rms = max(errs)
+    # unit source -> |subgrid| == 1/N² everywhere; relative = rms * N²
+    return {
+        "config": config_name,
+        "N": N,
+        "mode": mode,
+        "n_samples": len(errs),
+        "rms_abs": float(f"{rms:.3e}"),
+        "rms_rel": float(f"{rms * N * N:.3e}"),
+        "elapsed_s": round(elapsed, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--configs", default=",".join(DEFAULT_CONFIGS))
+    ap.add_argument("--mode", default="auto",
+                    choices=["auto", "batched", "streamed"])
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    rows = []
+    print(f"{'config':24s} {'N':>6s} {'mode':>9s} {'abs RMS':>10s} "
+          f"{'rel RMS':>10s} {'time':>7s}")
+    for name in args.configs.split(","):
+        row = measure(name, args.mode)
+        rows.append(row)
+        print(f"{row['config']:24s} {row['N']:6d} {row['mode']:>9s} "
+              f"{row['rms_abs']:10.3e} {row['rms_rel']:10.3e} "
+              f"{row['elapsed_s']:6.1f}s")
+    if args.json:
+        Path(args.json).write_text(json.dumps(rows, indent=2))
+
+
+if __name__ == "__main__":
+    main()

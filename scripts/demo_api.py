@@ -31,7 +31,7 @@ from scripts.utils import (
 log = logging.getLogger("swiftly-tpu.demo")
 
 
-def demo_api(args, params):
+def demo_api(args, params, config_name=""):
     """Run one config end-to-end; returns max facet RMS error."""
     from swiftly_tpu import (
         SWIFT_CONFIGS,
@@ -43,7 +43,11 @@ def demo_api(args, params):
         make_full_facet_cover,
         make_full_subgrid_cover,
     )
-    from swiftly_tpu.utils.profiling import device_memory_stats, trace
+    from swiftly_tpu.utils.profiling import (
+        MemorySampler,
+        device_memory_stats,
+        trace,
+    )
 
     mesh = resolve_mesh(args.mesh_devices)
     config = SwiftlyConfig(backend=args.backend, mesh=mesh, **params)
@@ -87,8 +91,9 @@ def demo_api(args, params):
         bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
                               args.queue_size)
 
+    sampler = MemorySampler()
     t0 = time.time()
-    with trace(args.profile_dir):
+    with trace(args.profile_dir), sampler.sample():
         if streamed:
             done = 0
             for items, subgrids in fwd.stream_columns(subgrid_configs):
@@ -115,7 +120,8 @@ def demo_api(args, params):
     log.info("forward+backward round trip: %.2fs (%.3fs/subgrid)",
              elapsed, elapsed / len(subgrid_configs))
 
-    for dev, stats in device_memory_stats().items():
+    mem_stats = device_memory_stats()
+    for dev, stats in mem_stats.items():
         log.info("device %s: %s in use", dev,
                  human_readable_size(stats.get("bytes_in_use", 0)))
 
@@ -125,7 +131,80 @@ def demo_api(args, params):
     ]
     for fc, err in zip(facet_configs, errors):
         log.info("facet off0/off1 %d/%d RMS %e", fc.off0, fc.off1, err)
+
+    if args.artifact_dir:
+        _write_artifacts(
+            args, config, config_name, mesh, len(subgrid_configs),
+            elapsed, errors, sampler, mem_stats,
+        )
     return max(errors)
+
+
+def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
+                     errors, sampler, mem_stats):
+    """Per-run artifacts: memory CSV + transfer bytes + summary JSON.
+
+    Parity with the reference demo's performance-report HTML, memory CSV
+    and transfer-bytes txt (reference scripts/demo_api.py:125-148) — the
+    transfer numbers here are analytic (collective bytes are exactly
+    computable on a mesh) rather than scraped from worker logs.
+    """
+    import json
+
+    from swiftly_tpu.utils.profiling import (
+        collective_bytes_backward,
+        collective_bytes_forward,
+    )
+
+    out = Path(args.artifact_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    tag = (config_name or "run").replace("/", "_")
+    mem_csv = out / f"mem_{tag}.csv"
+    sampler.to_csv(mem_csv)
+
+    n_dev = 1 if mesh is None else mesh.devices.size
+    planar = config.core.backend == "planar"
+    dtype = config.core.dtype if planar else np.float64
+    transfer = {
+        "n_devices": n_dev,
+        "forward_bytes_per_subgrid": collective_bytes_forward(
+            config.core.xM_size, n_dev, dtype, planar
+        ),
+        "backward_bytes_per_subgrid": collective_bytes_backward(
+            config.max_subgrid_size, n_dev, dtype, planar
+        ),
+    }
+    transfer["forward_bytes_total"] = (
+        transfer["forward_bytes_per_subgrid"] * n_subgrids
+    )
+    transfer["backward_bytes_total"] = (
+        transfer["backward_bytes_per_subgrid"] * n_subgrids
+    )
+
+    summary = {
+        "config": config_name,
+        "backend": args.backend,
+        "precision": args.precision,
+        "execution": args.execution,
+        "n_subgrids": n_subgrids,
+        "elapsed_s": round(elapsed, 3),
+        "s_per_subgrid": round(elapsed / n_subgrids, 5),
+        "max_facet_rms": max(errors),
+        "facet_rms": errors,
+        "transfer": transfer,
+        "device_memory": {
+            dev: {
+                k: stats.get(k)
+                for k in ("bytes_in_use", "peak_bytes_in_use")
+                if k in stats
+            }
+            for dev, stats in mem_stats.items()
+        },
+        "memory_csv": str(mem_csv),
+    }
+    summary_path = out / f"summary_{tag}.json"
+    summary_path.write_text(json.dumps(summary, indent=2))
+    log.info("artifacts written: %s, %s", mem_csv, summary_path)
 
 
 def main():
@@ -139,7 +218,7 @@ def main():
         params = dict(SWIFT_CONFIGS[name])
         params.setdefault("fov", 1.0)
         log.info("=== %s ===", name)
-        max_err = demo_api(args, params)
+        max_err = demo_api(args, params, config_name=name)
         log.info("%s: max facet RMS error %e", name, max_err)
 
 

@@ -113,6 +113,15 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
         default=None,
         help="write a jax.profiler trace to this directory",
     )
+    parser.add_argument(
+        "--artifact_dir",
+        type=str,
+        default=None,
+        help="write per-run artifacts here: device-memory samples CSV, "
+             "analytic collective-transfer bytes, and a summary JSON "
+             "(parity with the reference demo's performance report / "
+             "memory CSV / transfer txt)",
+    )
     return parser
 
 

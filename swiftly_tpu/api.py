@@ -305,6 +305,34 @@ def _group_columns(subgrid_configs, key=lambda sg: sg, require_one_size=False):
     return groups, rectangular
 
 
+def _pad_ragged_columns(groups, size, make_pad=None):
+    """Pad ragged columns ({off0: [(index, SubgridConfig), ...]}) to equal
+    length with zero-mask entries (index None) appended at the end.
+
+    Exact by construction: a zero mask zeroes a padded entry's output
+    (forward), and zero data contributes zeros to every linear
+    accumulation (backward). `make_pad(off0, first_config)` customises
+    the padded item; the default appends (None, zero-mask config).
+    """
+    max_S = max(len(col) for col in groups.values())
+    zero_mask = np.zeros(size)
+    for off0, col in groups.items():
+        first = col[0][1] if make_pad is None else None
+        while len(col) < max_S:
+            if make_pad is not None:
+                col.append(make_pad(off0, col[0]))
+            else:
+                col.append(
+                    (
+                        None,
+                        SubgridConfig(
+                            off0, first.off1, size, zero_mask, zero_mask
+                        ),
+                    )
+                )
+    return max_S
+
+
 # ---------------------------------------------------------------------------
 # Forward: facets -> subgrids
 # ---------------------------------------------------------------------------
@@ -390,10 +418,12 @@ class SwiftlyForward:
 
         Groups the requests by column offset (off0) and computes each
         column's subgrids in a single batched program — same results as
-        mapping `get_subgrid_task`, with far fewer dispatches. Returns the
+        mapping `get_subgrid_task`, with far fewer dispatches. On a mesh
+        the column program runs under shard_map with a single psum per
+        column (or via GSPMD inference in "gspmd" mode). Returns the
         subgrids in input order.
         """
-        if self.mesh is not None or self.core.backend in ("numpy", "native"):
+        if self.core.backend in ("numpy", "native"):
             return [self.get_subgrid_task(sg) for sg in subgrid_configs]
         groups = {}  # (off0, size) -> list of input indices
         for i, sg in enumerate(subgrid_configs):
@@ -401,16 +431,21 @@ class SwiftlyForward:
         results = [None] * len(subgrid_configs)
         for (off0, size), idxs in groups.items():
             cols = self._get_columns(off0)
-            stacked = batched.subgrids_from_columns_batch(
-                self.core,
-                cols,
-                self._offs0,
-                self._offs1,
-                [(subgrid_configs[i].off0, subgrid_configs[i].off1)
-                 for i in idxs],
-                size,
-                [_subgrid_masks(subgrid_configs[i]) for i in idxs],
-            )
+            sg_offs = [
+                (subgrid_configs[i].off0, subgrid_configs[i].off1)
+                for i in idxs
+            ]
+            masks = [_subgrid_masks(subgrid_configs[i]) for i in idxs]
+            if self.mesh is not None and _use_shard_map(self.config):
+                stacked = sharded.subgrids_from_columns_sharded(
+                    self.core, self.mesh, cols, self._offs0, self._offs1,
+                    sg_offs, size, masks,
+                )
+            else:
+                stacked = batched.subgrids_from_columns_batch(
+                    self.core, cols, self._offs0, self._offs1, sg_offs,
+                    size, masks,
+                )
             # One queue slot per subgrid, not per program: queue_size
             # keeps bounding in-flight *subgrids* regardless of batching.
             self.queue.admit([stacked] * len(idxs))
@@ -424,10 +459,13 @@ class SwiftlyForward:
         Returns a stacked device array [n, xA, xA(, 2)] in request order —
         a single XLA dispatch (scan over columns) and thus a single host
         sync for the entire forward transform; the latency-optimal path
-        for remote-attached TPUs. Falls back to the per-column streaming
-        path for irregular (ragged-column) covers, meshes, and host
-        backends. All subgrids must share one size (the output is
-        stacked); raises ValueError otherwise.
+        for remote-attached TPUs. On a mesh the fused program runs under
+        shard_map with one psum per scanned column ("gspmd" mode lets XLA
+        infer the same collectives). Irregular (ragged-column) covers
+        stay on the fused path via exact zero-mask padding; only host
+        backends fall back to per-column streaming. All subgrids must
+        share one size (the output is stacked); raises ValueError
+        otherwise.
         """
         subgrid_configs = list(subgrid_configs)
         groups, rectangular = _group_columns(
@@ -435,37 +473,49 @@ class SwiftlyForward:
             key=lambda item: item[1],
             require_one_size=True,
         )
-        if (
-            not rectangular
-            or self.mesh is not None
-            or self.core.backend in ("numpy", "native")
-        ):
-            import jax.numpy as jnp
-
+        if self.core.backend in ("numpy", "native"):
             tasks = self.get_subgrid_tasks(subgrid_configs)
-            if self.core.backend in ("numpy", "native"):
-                return np.stack([np.asarray(t) for t in tasks])
-            return jnp.stack(tasks)
+            return np.stack([np.asarray(t) for t in tasks])
         import jax.numpy as jnp
 
         size = subgrid_configs[0].size
+        if not rectangular:
+            # Ragged (sparse/irregular) cover: pad short columns with
+            # zero-mask entries — exact (padded rows are computed then
+            # discarded; their masks are all zero) and cheap, and it
+            # keeps the whole cover a single fused dispatch.
+            _pad_ragged_columns(groups, size)
         col_offs0 = list(groups)
-        sg_offs1, masks0, masks1, order = [], [], [], []
-        for off0 in col_offs0:
-            idxs = [i for i, _ in groups[off0]]
-            order.extend(idxs)
-            sg_offs1.append([subgrid_configs[i].off1 for i in idxs])
-            ms = [_subgrid_masks(subgrid_configs[i]) for i in idxs]
+        max_S = len(groups[col_offs0[0]])
+        sg_offs1, masks0, masks1, rows = [], [], [], {}
+        for c, off0 in enumerate(col_offs0):
+            col = groups[off0]
+            for s, (i, _) in enumerate(col):
+                if i is not None:
+                    rows[i] = c * max_S + s
+            sg_offs1.append([sg.off1 for _, sg in col])
+            ms = [_subgrid_masks(sg) for _, sg in col]
             masks0.append([m[0] for m in ms])
             masks1.append([m[1] for m in ms])
-        stacked = batched.forward_all_batch(
-            self.core, self._get_BF_Fs(), self._offs0, self._offs1,
-            col_offs0, sg_offs1, size, masks0, masks1,
+        if self.mesh is not None and _use_shard_map(self.config):
+            stacked = sharded.forward_all_sharded(
+                self.core, self.mesh, self._get_BF_Fs(), self._offs0,
+                self._offs1, col_offs0, sg_offs1, size, masks0, masks1,
+            )
+        else:
+            stacked = batched.forward_all_batch(
+                self.core, self._get_BF_Fs(), self._offs0, self._offs1,
+                col_offs0, sg_offs1, size, masks0, masks1,
+            )
+        flat = stacked.reshape(
+            (len(col_offs0) * max_S,) + stacked.shape[2:]
         )
-        flat = stacked.reshape((len(subgrid_configs),) + stacked.shape[2:])
-        if order != list(range(len(subgrid_configs))):
-            inv = np.argsort(np.asarray(order))
-            flat = jnp.take(flat, jnp.asarray(inv), axis=0)
+        n = len(subgrid_configs)
+        order = [rows[i] for i in range(n)]
+        if order != list(range(n)):
+            flat = jnp.take(flat, jnp.asarray(order), axis=0)
+        elif flat.shape[0] != n:  # identity order but tail padding rows
+            flat = flat[:n]
         # One queue slot per subgrid (not per program), like
         # get_subgrid_tasks: queue_size keeps bounding in-flight subgrids.
         self.queue.admit([flat] * len(subgrid_configs))
@@ -558,7 +608,7 @@ class SwiftlyBackward:
         """
         if self._finished:
             raise RuntimeError("finish() was already called")
-        if self.mesh is not None or self.core.backend in ("numpy", "native"):
+        if self.core.backend in ("numpy", "native"):
             for sg_config, data in tasks:
                 self.add_new_subgrid_task(sg_config, data)
             return
@@ -572,14 +622,18 @@ class SwiftlyBackward:
             col = self.lru.get(off0)
             if col is None:
                 col = self._zeros((len(stack), core.xM_yN_size, core.yN_size))
-            col = batched.split_accumulate_batch(
-                core,
-                [d for _, d in group],
-                [(sg.off0, sg.off1) for sg, _ in group],
-                self._offs0,
-                self._offs1,
-                col,
-            )
+            subgrid_data = [d for _, d in group]
+            sg_offs = [(sg.off0, sg.off1) for sg, _ in group]
+            if self.mesh is not None and _use_shard_map(self.config):
+                col = sharded.split_accumulate_sharded(
+                    core, self.mesh, subgrid_data, sg_offs,
+                    self._offs0, self._offs1, col,
+                )
+            else:
+                col = batched.split_accumulate_batch(
+                    core, subgrid_data, sg_offs, self._offs0, self._offs1,
+                    col,
+                )
             evicted_off0, evicted = self.lru.set(off0, col)
             if evicted is not None:
                 self._fold_column(evicted_off0, evicted)
@@ -627,8 +681,11 @@ def backward_all(swiftly_config, facet_configs, subgrid_tasks):
 
     Single XLA dispatch (scan over subgrid columns); numerically identical
     to streaming the same subgrids through `SwiftlyBackward` (every
-    accumulation is a sum of linear contributions). Falls back to the
-    streaming path for irregular covers, meshes, and host backends.
+    accumulation is a sum of linear contributions). On a mesh the fused
+    program runs under shard_map with facet-shard-local accumulation (no
+    collectives; "gspmd" mode lets XLA infer the same). Ragged covers
+    stay on the fused path via exact zero-data padding; mixed subgrid
+    sizes and host backends fall back to the streaming path.
     """
     core = swiftly_config.core
     mesh = getattr(swiftly_config, "mesh", None)
@@ -636,21 +693,41 @@ def backward_all(swiftly_config, facet_configs, subgrid_tasks):
     groups, rectangular = _group_columns(
         subgrid_tasks, key=lambda item: item[0]
     )
-    if not rectangular or mesh is not None or core.backend in (
-        "numpy", "native",
-    ):
+    sizes = {sg.size for sg, _ in subgrid_tasks}
+    if len(sizes) != 1 or core.backend in ("numpy", "native"):
         bwd = SwiftlyBackward(swiftly_config, facet_configs)
         bwd.add_new_subgrid_tasks(subgrid_tasks)
         return bwd.finish()
+    if not rectangular:
+        # Ragged cover: pad short columns with zero-data subgrids —
+        # exact, since every accumulation is linear in the subgrid data.
+        size = sizes.pop()
+        zero_data = np.zeros((size, size), dtype=complex)
+        _pad_ragged_columns(
+            groups, size,
+            make_pad=lambda off0, first: (
+                SubgridConfig(off0, first[0].off1, size), zero_data
+            ),
+        )
 
-    stack = _FacetStack(facet_configs)
-    # nested lists: backward_all_batch preps and stacks them itself
+    stack = _FacetStack(facet_configs, pad_to=_mesh_size(mesh))
+    # nested lists: the batch kernels prep and stack them themselves
     subgrids = [[d for _, d in groups[off0]] for off0 in groups]
     sg_offs = [
         [(sg.off0, sg.off1) for sg, _ in groups[off0]] for off0 in groups
     ]
-    facets = batched.backward_all_batch(
-        core, subgrids, sg_offs, stack.offs0, stack.offs1,
-        stack.masks0, stack.masks1, stack.size,
-    )
+    offs0 = _place(core, mesh, stack.offs0, True)
+    offs1 = _place(core, mesh, stack.offs1, True)
+    masks0 = _place(core, mesh, stack.masks0, True)
+    masks1 = _place(core, mesh, stack.masks1, True)
+    if mesh is not None and _use_shard_map(swiftly_config):
+        facets = sharded.backward_all_sharded(
+            core, mesh, subgrids, sg_offs, offs0, offs1,
+            masks0, masks1, stack.size,
+        )
+    else:
+        facets = batched.backward_all_batch(
+            core, subgrids, sg_offs, offs0, offs1, masks0, masks1,
+            stack.size,
+        )
     return facets[: stack.n_real]

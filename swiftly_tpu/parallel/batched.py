@@ -68,6 +68,14 @@ def _mask_along(p, data, mask, axis):
     return data * p.broadcast_along(mask, p.ndim(data), axis)
 
 
+def _as_real(x, rdt):
+    """Nested mask lists -> a device array of the core's real dtype,
+    keeping already-placed jax arrays (and their sharding) as-is."""
+    if hasattr(x, "sharding"):
+        return x if x.dtype == rdt else x.astype(rdt)
+    return jnp.asarray(np.asarray(x), rdt)
+
+
 def facet_contrib_to_subgrid(core, NMBF_BF, foff0, foff1, sg_off1):
     """One facet's column block -> its padded-subgrid summand [xM, xM].
 
@@ -131,8 +139,7 @@ def prepare_facets_batch(core, facets, offs0):
     return _prepare_facets_j(core, core._prep(facets), jnp.asarray(offs0))
 
 
-@functools.partial(jax.jit, static_argnums=0)
-def _extract_columns_j(core, BF_Fs, off0, offs1):
+def _extract_columns_fn(core, BF_Fs, off0, offs1):
     def fn(BF_F, off1):
         col = extract_from_facet_math(
             core._p, core.xM_yN_size, core.N, core.yN_size, BF_F, off0, 0
@@ -142,6 +149,11 @@ def _extract_columns_j(core, BF_Fs, off0, offs1):
         )
 
     return jax.vmap(fn)(BF_Fs, offs1)
+
+
+_extract_columns_j = functools.partial(jax.jit, static_argnums=0)(
+    _extract_columns_fn
+)
 
 
 def extract_columns_batch(core, BF_Fs, off0, offs1):
@@ -333,8 +345,8 @@ def forward_all_batch(
         jnp.asarray(col_offs0),
         jnp.asarray(sg_offs1),
         subgrid_size,
-        jnp.asarray(np.asarray(masks0), rdt),
-        jnp.asarray(np.asarray(masks1), rdt),
+        _as_real(masks0, rdt),
+        _as_real(masks1, rdt),
     )
 
 
@@ -607,7 +619,6 @@ def backward_all_batch(
         subgrids,
         jnp.asarray(np.asarray(sg_offs)),
         (jnp.asarray(offs0), jnp.asarray(offs1)),
-        (jnp.asarray(np.asarray(masks0), rdt),
-         jnp.asarray(np.asarray(masks1), rdt)),
+        (_as_real(masks0, rdt), _as_real(masks1, rdt)),
         facet_size,
     )

@@ -52,6 +52,16 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def varying(x, axis_name: str):
+    """Tag `x` as varying over a shard_map axis.
+
+    shard_map tracks which values vary per shard; a `jnp.zeros` scan
+    carry created inside the mapped body starts out unvarying and fails
+    the carry-type check once the scan body mixes in shard-varying data.
+    """
+    return jax.lax.pcast(x, (axis_name,), to="varying")
+
+
 def pad_to_shards(n: int, n_shards: int) -> int:
     """Facet count padded up to a multiple of the mesh size.
 

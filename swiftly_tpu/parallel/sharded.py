@@ -39,17 +39,27 @@ try:  # jax >= 0.6 exports shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+import numpy as np
+
 from ..ops.core import prepare_subgrid_math
 from .batched import (
+    _accumulate_facet_fn,
+    _extract_columns_fn,
+    _finish_facets_fn,
+    _split_accumulate_fn,
     facet_contrib_to_subgrid,
     finish_masked_subgrid,
     subgrid_contrib_to_facet,
 )
-from .mesh import FACET_AXIS
+from .mesh import FACET_AXIS, varying
 
 __all__ = [
+    "backward_all_sharded",
+    "forward_all_sharded",
+    "split_accumulate_sharded",
     "split_subgrid_sharded",
     "subgrid_from_columns_sharded",
+    "subgrids_from_columns_sharded",
 ]
 
 
@@ -134,4 +144,257 @@ def split_subgrid_sharded(
         jnp.asarray([sg_off0, sg_off1]),
         jnp.asarray(offs0),
         jnp.asarray(offs1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused column/whole-cover mesh programs
+#
+# The per-subgrid kernels above cost one dispatch (and one psum) per
+# subgrid — dispatch-latency-bound on remote-attached devices, exactly the
+# disease the single-device fused paths cured. These kernels batch a whole
+# column (or the whole cover) into ONE shard_map program with ONE psum per
+# column: per-device work scales with local facets (F/d), cross-device
+# traffic is one [S, xM, xM] buffer per column.
+# ---------------------------------------------------------------------------
+
+
+def _column_partial_then_finish(core, cols, offs0, offs1, off0, col_sg_offs1,
+                                col_m0, col_m1, subgrid_size):
+    """Local facet reduction for all S subgrids of one column, one psum,
+    then the (replicated) finishes. Shared by the column and whole-cover
+    kernels."""
+
+    def partial_sg(off1):
+        contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
+            core, NMBF_BF, foff0, foff1, off1
+        )
+        return jnp.sum(jax.vmap(contrib)(cols, offs0, offs1), axis=0)
+
+    partial = jax.vmap(partial_sg)(col_sg_offs1)  # [S, xM, xM] local
+    summed = jax.lax.psum(partial, FACET_AXIS)  # one collective per column
+
+    def fin(s, off1, m0, m1):
+        return finish_masked_subgrid(
+            core, s, jnp.stack([off0, off1]), subgrid_size, m0, m1
+        )
+
+    return jax.vmap(fin)(summed, col_sg_offs1, col_m0, col_m1)
+
+
+@functools.lru_cache(maxsize=32)
+def _forward_column_kernel(core, mesh, subgrid_size: int):
+    """One column's S subgrids in one program: single psum per column."""
+
+    def body(NMBF_BFs, offs0, offs1, off0, sg_offs1, masks0, masks1):
+        return _column_partial_then_finish(
+            core, NMBF_BFs, offs0, offs1, off0, sg_offs1, masks0, masks1,
+            subgrid_size,
+        )
+
+    mapped = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(mapped)
+
+
+def subgrids_from_columns_sharded(
+    core, mesh, NMBF_BFs, offs0, offs1, sg_offs_list, subgrid_size, masks_list
+):
+    """All subgrids of one column on the mesh: [S, xA, xA], one dispatch.
+
+    Mesh analogue of ``batched.subgrids_from_columns_batch``: local facet
+    reduction + a single psum for the whole stacked column.
+    """
+    fn = _forward_column_kernel(core, mesh, subgrid_size)
+    rdt = core._Fb.dtype
+    return fn(
+        NMBF_BFs,
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        jnp.asarray(sg_offs_list[0][0]),
+        jnp.asarray([so[1] for so in sg_offs_list]),
+        jnp.asarray(np.stack([m[0] for m in masks_list]), rdt),
+        jnp.asarray(np.stack([m[1] for m in masks_list]), rdt),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _forward_all_kernel(core, mesh, subgrid_size: int):
+    """The whole forward cover as ONE shard_map program.
+
+    Scan over columns; per column: extract the local facets' column
+    blocks, reduce their contributions for all S subgrids, one psum,
+    finish. O(1) dispatches and O(columns) collectives for the entire
+    transform — the mesh analogue of ``batched.forward_all_batch``.
+    """
+
+    def body(BF_Fs, offs0, offs1, col_offs0, sg_offs1, masks0, masks1):
+        def one_column(_, xs):
+            off0, col_sg_offs1, col_m0, col_m1 = xs
+            cols = _extract_columns_fn(core, BF_Fs, off0, offs1)
+            return None, _column_partial_then_finish(
+                core, cols, offs0, offs1, off0, col_sg_offs1, col_m0,
+                col_m1, subgrid_size,
+            )
+
+        _, subgrids = jax.lax.scan(
+            one_column, None, (col_offs0, sg_offs1, masks0, masks1)
+        )
+        return subgrids
+
+    mapped = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(mapped)
+
+
+def forward_all_sharded(
+    core, mesh, BF_Fs, offs0, offs1, col_offs0, sg_offs1, subgrid_size,
+    masks0, masks1,
+):
+    """The full forward cover on the mesh: [C, S, xA, xA], one dispatch.
+
+    Same contract as ``batched.forward_all_batch`` with the facet
+    reduction as one explicit psum per scanned column.
+    """
+    fn = _forward_all_kernel(core, mesh, subgrid_size)
+    rdt = core._Fb.dtype
+    return fn(
+        BF_Fs,
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        jnp.asarray(col_offs0),
+        jnp.asarray(sg_offs1),
+        jnp.asarray(np.asarray(masks0), rdt),
+        jnp.asarray(np.asarray(masks1), rdt),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _backward_column_kernel(core, mesh):
+    """Fold one column's stacked subgrids into the facet-sharded
+    per-column accumulator — all facet work is local (the subgrids are
+    replicated; no collectives at all)."""
+
+    def body(subgrids, sg_offs_arr, offs0, offs1, NAF_MNAFs):
+        return _split_accumulate_fn(
+            core, subgrids, sg_offs_arr, (offs0, offs1), NAF_MNAFs
+        )
+
+    mapped = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS),
+        ),
+        out_specs=P(FACET_AXIS),
+    )
+    return jax.jit(mapped, donate_argnums=4)
+
+
+def split_accumulate_sharded(
+    core, mesh, subgrids, sg_offs_list, offs0, offs1, NAF_MNAFs
+):
+    """Mesh analogue of ``batched.split_accumulate_batch``: one dispatch
+    folds a whole column of subgrids into its facet-sharded accumulator
+    (donated)."""
+    if isinstance(subgrids, (list, tuple)):
+        subgrids = jnp.stack([core._prep(sg) for sg in subgrids])
+    fn = _backward_column_kernel(core, mesh)
+    return fn(
+        subgrids,
+        jnp.asarray(sg_offs_list),
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        NAF_MNAFs,
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _backward_all_kernel(core, mesh, facet_size: int):
+    """The whole backward cover as ONE shard_map program.
+
+    Subgrids arrive replicated; every facet-side op (extract, accumulate,
+    finish) is local to the facet shard, so the program needs NO
+    collectives — the facet stack materialises sharded (out_specs
+    P(facet)). Mesh analogue of ``batched.backward_all_batch``.
+    """
+
+    def body(subgrids, sg_offs, offs0, offs1, masks0, masks1):
+        F = offs0.shape[0]
+        # scan carries must be tagged shard-varying up front: their
+        # updates mix in the facet-sharded offsets/masks
+        zeros_col = varying(
+            jnp.zeros(
+                (F, core.xM_yN_size, core.yN_size) + subgrids.shape[4:],
+                dtype=subgrids.dtype,
+            ),
+            FACET_AXIS,
+        )
+
+        def one_column(MNAF_BMNAFs, xs):
+            col_sgs, col_offs = xs
+            NAF_MNAFs = _split_accumulate_fn(
+                core, col_sgs, col_offs, (offs0, offs1), zeros_col
+            )
+            MNAF_BMNAFs = _accumulate_facet_fn(
+                core, NAF_MNAFs, col_offs[0, 0], offs1, masks1, facet_size,
+                MNAF_BMNAFs,
+            )
+            return MNAF_BMNAFs, None
+
+        init = varying(
+            jnp.zeros(
+                (F, core.yN_size, facet_size) + subgrids.shape[4:],
+                dtype=subgrids.dtype,
+            ),
+            FACET_AXIS,
+        )
+        MNAF_BMNAFs, _ = jax.lax.scan(one_column, init, (subgrids, sg_offs))
+        return _finish_facets_fn(core, MNAF_BMNAFs, offs0, masks0, facet_size)
+
+    mapped = _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(), P(), P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS),
+            P(FACET_AXIS),
+        ),
+        out_specs=P(FACET_AXIS),
+    )
+    return jax.jit(mapped)
+
+
+def backward_all_sharded(
+    core, mesh, subgrids, sg_offs, offs0, offs1, masks0, masks1, facet_size
+):
+    """The full backward cover on the mesh: facets [F, yB, yB], one
+    dispatch, zero collectives (facet work is shard-local).
+
+    Same contract as ``batched.backward_all_batch``.
+    """
+    if isinstance(subgrids, (list, tuple)):
+        subgrids = jnp.stack(
+            [jnp.stack([core._prep(sg) for sg in col]) for col in subgrids]
+        )
+    fn = _backward_all_kernel(core, mesh, facet_size)
+    rdt = core._Fb.dtype
+    return fn(
+        subgrids,
+        jnp.asarray(np.asarray(sg_offs)),
+        jnp.asarray(offs0),
+        jnp.asarray(offs1),
+        jnp.asarray(np.asarray(masks0), rdt),
+        jnp.asarray(np.asarray(masks1), rdt),
     )

@@ -12,15 +12,19 @@ api.py:300-324,402-438).
 
 Forward (facets -> subgrids), two device passes:
 
-1. *Facet pass* — stream facet column-blocks [F, yB, Cb] to the device;
-   prepare along axis 0 and extract the contribution rows for EVERY
-   subgrid column offset in one program -> [K, F, m, Cb]. The results
-   land in the `NMBF_all` buffer [K, F, m, yB] (host RAM, or device HBM
-   when it fits — `residency="device"`). Total size equals one prepared
-   facet stack re-indexed by column: K*m ≈ yN.
-2. *Column pass* — per subgrid column k: upload `NMBF_all[k]` [F, m, yB],
-   prepare along axis 1, extract/accumulate/finish all S subgrids of the
-   column in one program -> [S, xA, xA].
+1. *Facet pass* — with `residency="host"`: stream facet column-blocks
+   [F, yB, Cb] to the device; prepare along axis 0 and extract the
+   contribution rows for EVERY subgrid column offset in one program ->
+   [K, F, m, Cb], landing in the host-RAM `NMBF_all` buffer
+   [K, F, m, yB] (total size equals one prepared facet stack re-indexed
+   by column: K*m ≈ yN). With `residency="device"` the facet pass is a
+   sampled DFT instead: facets upload once and stay in HBM, and each
+   group of columns' contribution rows is one einsum — no NMBF buffer
+   exists (see `_facet_pass_sampled_j`).
+2. *Column pass* — per subgrid column k: take the column's [F, m, yB]
+   rows (host upload, or a slice of the sampled group buffer), prepare
+   along axis 1, extract/accumulate/finish all S subgrids of the column
+   in one program -> [S, xA, xA].
 
 Backward (subgrids -> facets) is the exact dual:
 
@@ -89,9 +93,28 @@ def _to_host_layout(core, data):
 
 import jax  # noqa: E402
 
+from jax.sharding import NamedSharding, PartitionSpec as _P  # noqa: E402
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map  # noqa: E402
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map  # noqa: E402
+
+from .mesh import FACET_AXIS, varying  # noqa: E402
+
+
+def _mesh_size(mesh):
+    return 1 if mesh is None else mesh.devices.size
+
 
 # ---------------------------------------------------------------------------
 # Stage programs
+#
+# Each stage has a pure body builder (`*_fn`) shared by the single-device
+# jit (`*_j`) and the facet-sharded shard_map variant (`*_sharded`). On a
+# mesh every per-facet op is shard-local; the only collective in the whole
+# streamed pipeline is one psum per subgrid column in the forward column
+# pass (`axis_name` below).
 # ---------------------------------------------------------------------------
 
 
@@ -101,11 +124,13 @@ def _jit(static=(), donate=()):
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _facet_pass_fwd_j(core):
-    """facet block [F, yB', Cb] -> contribution rows [K, F, m, Cb]."""
-    import jax
+def _shmap(fn, mesh, in_specs, out_specs, donate=()):
+    mapped = _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    return jax.jit(mapped, donate_argnums=donate)
 
+
+def _facet_pass_fwd_fn(core):
+    """facet block [F, yB', Cb] -> contribution rows [K, F, m, Cb]."""
     p = core._p
 
     def fn(facet_block, foffs0, col_offs0):
@@ -122,14 +147,31 @@ def _facet_pass_fwd_j(core):
         out = jax.vmap(per_facet)(facet_block, foffs0)  # [F, K, m, Cb]
         return jax.numpy.swapaxes(out, 0, 1)  # [K, F, m, Cb]
 
-    return _jit(static=())(fn)
+    return fn
 
 
 @functools.lru_cache(maxsize=None)
-def _column_pass_fwd_j(core, subgrid_size):
-    """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA]."""
-    import jax
+def _facet_pass_fwd_j(core):
+    return _jit()(_facet_pass_fwd_fn(core))
 
+
+@functools.lru_cache(maxsize=None)
+def _facet_pass_fwd_sharded(core, mesh):
+    """Facet-sharded forward facet pass (all ops shard-local)."""
+    return _shmap(
+        _facet_pass_fwd_fn(core), mesh,
+        in_specs=(_P(FACET_AXIS), _P(FACET_AXIS), _P()),
+        out_specs=_P(None, FACET_AXIS),
+    )
+
+
+def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
+    """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
+
+    With `axis_name`, F is the local facet shard and the per-column
+    reduction finishes with ONE psum over the stacked [S, xM, xM]
+    partials — the streamed pipeline's only collective.
+    """
     p = core._p
 
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0, masks1):
@@ -138,27 +180,47 @@ def _column_pass_fwd_j(core, subgrid_size):
 
         NMBF_BF = jax.vmap(prep1)(NMBF, foffs1)  # [F, m, yN]
 
-        def one(sg_off_pair, m0, m1):
+        def partial(sg_off_pair):
             contrib = lambda bf, f0, f1: facet_contrib_to_subgrid(
                 core, bf, f0, f1, sg_off_pair[1]
             )
-            summed = jax.numpy.sum(
+            return jax.numpy.sum(
                 jax.vmap(contrib)(NMBF_BF, foffs0, foffs1), axis=0
             )
+
+        partials = jax.vmap(partial)(sg_offs)  # [S, xM, xM] (local facets)
+        if axis_name is not None:
+            partials = jax.lax.psum(partials, axis_name)
+
+        def fin(summed, sg_off_pair, m0, m1):
             return finish_masked_subgrid(
                 core, summed, sg_off_pair, subgrid_size, m0, m1
             )
 
-        return jax.vmap(one)(sg_offs, masks0, masks1)
+        return jax.vmap(fin)(partials, sg_offs, masks0, masks1)
 
-    return _jit(static=())(fn)
+    return fn
 
 
 @functools.lru_cache(maxsize=None)
-def _column_pass_bwd_j(core, n_subgrids, facet_size):
-    """A column's subgrids [S, xA, xA] -> NAF_BMNAF rows [F, m, yB]."""
-    import jax
+def _column_pass_fwd_j(core, subgrid_size):
+    return _jit()(_column_pass_fwd_fn(core, subgrid_size))
 
+
+@functools.lru_cache(maxsize=None)
+def _column_pass_fwd_sharded(core, mesh, subgrid_size):
+    return _shmap(
+        _column_pass_fwd_fn(core, subgrid_size, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
+            _P(), _P(), _P(),
+        ),
+        out_specs=_P(),
+    )
+
+
+def _column_pass_bwd_fn(core, facet_size, axis_name=None):
+    """A column's subgrids [S, xA, xA] -> NAF_BMNAF rows [F, m, yB]."""
     p = core._p
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
@@ -167,6 +229,10 @@ def _column_pass_bwd_j(core, n_subgrids, facet_size):
             (F, core.xM_yN_size, core.yN_size) + subgrids.shape[3:],
             dtype=subgrids.dtype,
         )
+        if axis_name is not None:
+            # scan carry must be tagged shard-varying (its updates mix in
+            # the facet-sharded offsets)
+            zeros = varying(zeros, axis_name)
         NAF_MNAFs = _split_accumulate_fn(
             core, subgrids, sg_offs, (foffs0, foffs1), zeros
         )
@@ -177,14 +243,29 @@ def _column_pass_bwd_j(core, n_subgrids, facet_size):
 
         return jax.vmap(fin)(NAF_MNAFs, foffs1, masks1)
 
-    return _jit(static=())(fn)
+    return fn
 
 
 @functools.lru_cache(maxsize=None)
-def _facet_pass_bwd_j(core, facet_size):
-    """NAF_BMNAF column-blocks [K, F, m, Cb] -> facet blocks [F, yB, Cb]."""
-    import jax
+def _column_pass_bwd_j(core, n_subgrids, facet_size):
+    return _jit()(_column_pass_bwd_fn(core, facet_size))
 
+
+@functools.lru_cache(maxsize=None)
+def _column_pass_bwd_sharded(core, mesh, facet_size):
+    """Facet-sharded backward column pass (subgrids replicated; the split
+    and fold are shard-local, no collectives)."""
+    return _shmap(
+        _column_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(), _P(), _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
+        ),
+        out_specs=_P(FACET_AXIS),
+    )
+
+
+def _facet_pass_bwd_fn(core, facet_size, axis_name=None):
+    """NAF_BMNAF column-blocks [K, F, m, Cb] -> facet blocks [F, yB, Cb]."""
     p = core._p
 
     def fn(blocks, col_offs0, foffs0, masks0):
@@ -199,6 +280,8 @@ def _facet_pass_bwd_j(core, facet_size):
         init = jax.numpy.zeros(
             (F, core.yN_size) + blocks.shape[3:], dtype=blocks.dtype
         )
+        if axis_name is not None:
+            init = varying(init, axis_name)
         acc, _ = jax.lax.scan(fold, init, (blocks, col_offs0))
 
         def fin(a, off0, m0):
@@ -207,7 +290,23 @@ def _facet_pass_bwd_j(core, facet_size):
 
         return jax.vmap(fin)(acc, foffs0, masks0)
 
-    return _jit(static=())(fn)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _facet_pass_bwd_j(core, facet_size):
+    return _jit()(_facet_pass_bwd_fn(core, facet_size))
+
+
+@functools.lru_cache(maxsize=None)
+def _facet_pass_bwd_sharded(core, mesh, facet_size):
+    return _shmap(
+        _facet_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(None, FACET_AXIS), _P(), _P(FACET_AXIS), _P(FACET_AXIS),
+        ),
+        out_specs=_P(FACET_AXIS),
+    )
 
 
 # -- sampled-DFT facet pass -------------------------------------------------
@@ -245,20 +344,42 @@ def sampled_row_indices(core, col_offs0):
     return np.concatenate(rows).astype(np.int32)
 
 
+def _mulmod(a, b, yN):
+    """(a*b) mod yN in int32, exact for any yN <= 2**16 (all catalogue
+    sizes).
+
+    A direct int32 product overflows once yN*yB exceeds 2**31 (e.g. the
+    64k configs); int64 is unreliable here because jax silently downcasts
+    it without x64. Instead reduce both operands mod yN and split b into
+    8-bit limbs: every partial product stays below yN * 2**8 <= 2**24.
+    """
+    import jax.numpy as jnp
+
+    if yN > 1 << 16:  # pragma: no cover - no such catalogue entry
+        raise ValueError(f"phase computation requires yN <= 65536, got {yN}")
+    a = jnp.mod(a, yN)
+    b = jnp.mod(b, yN)
+    b_hi, b_lo = b >> 8, b & 0xFF
+    hi = jnp.mod(a * b_hi, yN) << 8
+    return jnp.mod(hi + a * b_lo, yN)
+
+
 @functools.lru_cache(maxsize=None)
-def _facet_pass_sampled_j(core):
+def _facet_pass_sampled_fn(core):
     """facets [F, yB, Y(,2)] -> sampled contribution rows [F, R, Y(,2)].
 
     `krows` are centred spectral indices (from `sampled_row_indices`),
     `e0` the per-facet embedding shifts (facet_off0 - yB//2). One einsum
-    per call; works for the full column set or any chunk of it.
+    per call; works for the full column set or any chunk of it. Body
+    builder shared by the single-device jit and the facet-sharded
+    shard_map variant.
     """
     import jax.numpy as jnp
 
     yN = core.yN_size
 
-    def phases(prod_int):
-        theta = (2 * np.pi / yN) * jnp.mod(prod_int, yN)
+    def phases(residues):
+        theta = (2 * np.pi / yN) * residues
         return jnp.cos(theta), jnp.sin(theta)
 
     if _planar(core):
@@ -271,7 +392,7 @@ def _facet_pass_sampled_j(core):
             dt = Fr.dtype
             fb = core._p.extract_mid(core._Fb, yB, 0) / yN  # [yB] real
             j = jnp.arange(yB, dtype=jnp.int32)
-            a_cos, a_sin = phases(jnp.outer(krows, j))  # [R, yB]
+            a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
             A_re = (a_cos * fb[None, :]).astype(dt)
             A_im = (a_sin * fb[None, :]).astype(dt)
             from ..ops.planar_backend import _PRECISION
@@ -282,7 +403,9 @@ def _facet_pass_sampled_j(core):
             out_re = f(A_re, Fr) - f(A_im, Fi)
             out_im = f(A_re, Fi) + f(A_im, Fr)
             p_cos, p_sin = phases(
-                e0.astype(jnp.int32)[:, None] * krows[None, :]
+                _mulmod(
+                    e0.astype(jnp.int32)[:, None], krows[None, :], yN
+                )
             )  # [F, R]
             p_cos = p_cos.astype(dt)[..., None]
             p_sin = p_sin.astype(dt)[..., None]
@@ -300,16 +423,37 @@ def _facet_pass_sampled_j(core):
             yB = facets.shape[1]
             fb = core._p.extract_mid(core._Fb, yB, 0) / yN
             j = jnp.arange(yB, dtype=jnp.int32)
-            a_cos, a_sin = phases(jnp.outer(krows, j))
+            a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
             A = (a_cos + 1j * a_sin).astype(core.dtype) * fb[None, :]
             out = jnp.einsum("rj,fjc->frc", A, facets)
             p_cos, p_sin = phases(
-                e0.astype(jnp.int32)[:, None] * krows[None, :]
+                _mulmod(
+                    e0.astype(jnp.int32)[:, None], krows[None, :], yN
+                )
             )
             phi = (p_cos + 1j * p_sin).astype(core.dtype)
             return out * phi[..., None]
 
-    return _jit()(fn)
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _facet_pass_sampled_j(core):
+    return _jit()(_facet_pass_sampled_fn(core))
+
+
+@functools.lru_cache(maxsize=None)
+def _facet_pass_sampled_sharded(core, mesh):
+    """Facet-sharded sampled-DFT facet pass: each device's einsum covers
+    its local facets only (no collectives; the facet sum happens later in
+    the column pass psum)."""
+    n_arrays = 2 if _planar(core) else 1  # planes vs complex facets
+    in_specs = tuple([_P(FACET_AXIS)] * n_arrays) + (_P(FACET_AXIS), _P())
+    return _shmap(
+        _facet_pass_sampled_fn(core), mesh,
+        in_specs=in_specs,
+        out_specs=_P(FACET_AXIS),
+    )
 
 
 
@@ -325,6 +469,7 @@ class _StreamedBase:
 
         self.config = swiftly_config
         self.core = swiftly_config.core
+        self.mesh = getattr(swiftly_config, "mesh", None)
         if self.core.backend in ("numpy", "native"):
             raise ValueError(
                 "Streamed execution requires a device backend "
@@ -333,15 +478,28 @@ class _StreamedBase:
         if residency not in ("host", "device"):
             raise ValueError(f"residency must be host|device, got {residency}")
         self.residency = residency
-        self.stack = _FacetStack(facet_configs)
+        self.stack = _FacetStack(
+            facet_configs, pad_to=_mesh_size(self.mesh)
+        )
         self.col_block = int(col_block)
         yB = self.stack.size
         self._n_blocks = -(-yB // self.col_block)
         self._yB_pad = self._n_blocks * self.col_block
+        self._foffs0 = self._place(np.asarray(self.stack.offs0))
+        self._foffs1 = self._place(np.asarray(self.stack.offs1))
+
+    def _place(self, arr, facet_axis: int = 0):
+        """Upload an array, facet-sharding `facet_axis` over the mesh (or
+        plain default placement without one)."""
         import jax.numpy as jnp
 
-        self._foffs0 = jnp.asarray(self.stack.offs0)
-        self._foffs1 = jnp.asarray(self.stack.offs1)
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        spec = [None] * np.ndim(arr)
+        spec[facet_axis] = FACET_AXIS
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, _P(*spec))
+        )
 
     def _alloc_buffer(self, n_cols):
         F, m, yB = len(self.stack), self.core.xM_yN_size, self._yB_pad
@@ -350,8 +508,17 @@ class _StreamedBase:
 
 
 def _group_full_columns(subgrid_configs):
-    """Group configs by off0; require a rectangular single-size cover."""
-    from ..api import _group_columns
+    """Group configs by off0, padding ragged columns to equal length.
+
+    Sparse/irregular covers leave columns with unequal subgrid counts;
+    the stacked column programs need one static S. Short columns are
+    padded with zero-mask configs whose rows are computed then discarded
+    — exact (masks zero the padded outputs) and cheap (padding is at
+    most one column's worth of work). Padded entries carry index None
+    and sit at the END of each column, so program rows [0:n_real] always
+    match the real items.
+    """
+    from ..api import _group_columns, _pad_ragged_columns
 
     groups, rectangular = _group_columns(
         list(enumerate(subgrid_configs)),
@@ -359,10 +526,8 @@ def _group_full_columns(subgrid_configs):
         require_one_size=True,
     )
     if not rectangular:
-        raise ValueError(
-            "Streamed execution requires a rectangular cover: every "
-            "column (unique off0) must hold the same number of subgrids"
-        )
+        size = next(iter(groups.values()))[0][1].size
+        _pad_ragged_columns(groups, size)
     return groups
 
 
@@ -378,9 +543,13 @@ class StreamedForward:
     :param facet_tasks: list of (FacetConfig, facet_data) pairs
     :param col_block: facet columns per streamed block (device working-set
         knob; the analogue of the reference's queue/LRU sizing)
-    :param residency: where the NMBF_all buffer lives — "host" (default;
-        scales to any N that fits host RAM) or "device" (skips the
-        host round-trip when the buffer fits HBM)
+    :param residency: execution strategy — "host" (default) runs the
+        FFT-based facet pass and buffers NMBF_all in host RAM (scales to
+        any N that fits host RAM); "device" selects the facets-resident
+        sampled-DFT path: facets upload once and stay in HBM, each column
+        group's contribution rows are one einsum, and no NMBF buffer or
+        host round-trip exists at all (requires the facet stack to fit
+        HBM)
     """
 
     def __init__(self, swiftly_config, facet_tasks, col_block=512,
@@ -420,14 +589,17 @@ class StreamedForward:
 
         base = self._base
         core = base.core
-        fwd = _facet_pass_fwd_j(core)
+        if base.mesh is not None:
+            fwd = _facet_pass_fwd_sharded(core, base.mesh)
+        else:
+            fwd = _facet_pass_fwd_j(core)
         col_offs0_j = jnp.asarray(col_offs0)
         buf = base._alloc_buffer(len(col_offs0))
         Cb = base.col_block
         pending = []  # (j0, device result) — simple 2-deep pipeline
         for j0 in range(0, base._yB_pad, Cb):
             out = fwd(
-                jnp.asarray(self._facet_block(j0)), base._foffs0, col_offs0_j
+                base._place(self._facet_block(j0)), base._foffs0, col_offs0_j
             )
             pending.append((j0, out))
             if len(pending) > 1:
@@ -439,11 +611,10 @@ class StreamedForward:
         self._col_index = {int(off0): k for k, off0 in enumerate(col_offs0)}
 
     def _nmbf_column(self, k):
-        """The k'th column's [F, m, yB] rows as a device array."""
-        import jax.numpy as jnp
-
+        """The k'th column's [F, m, yB] rows as a device array
+        (facet-sharded on a mesh)."""
         yB = self._base.stack.size
-        return jnp.asarray(self._nmbf[k][:, :, :yB])
+        return self._base._place(self._nmbf[k][:, :, :yB])
 
     # -- column pass -------------------------------------------------------
 
@@ -478,7 +649,10 @@ class StreamedForward:
         subgrid_configs = list(subgrid_configs)
         groups = _group_full_columns(subgrid_configs)
         size = subgrid_configs[0].size
-        colfn = _column_pass_fwd_j(self.core, size)
+        if self._base.mesh is not None:
+            colfn = _column_pass_fwd_sharded(self.core, self._base.mesh, size)
+        else:
+            colfn = _column_pass_fwd_j(self.core, size)
         if self._base.residency == "device":
             gen = self._device_columns(groups, colfn)
         else:
@@ -503,9 +677,10 @@ class StreamedForward:
         ):
             self._build_nmbf(col_offs0)
         for off0 in col_offs0:
-            items = groups[off0]
+            prog_items = groups[off0]  # incl. zero-mask padding at the end
+            items = [it for it in prog_items if it[0] is not None]
             NMBF = self._nmbf_column(self._col_index[int(off0)])
-            yield items, self._column_program(colfn, NMBF, items)
+            yield items, self._column_program(colfn, NMBF, prog_items)
 
     def _device_columns(self, groups, colfn):
         """Facets-resident sampled-DFT pass in column groups.
@@ -538,22 +713,27 @@ class StreamedForward:
                             * n_pad
                         )
                     )
-                    planes.append(jnp.asarray(host))
+                    planes.append(base._place(host))
                 self._dev_facets = tuple(planes)
             else:
                 self._dev_facets = (
-                    jnp.stack(
-                        [jnp.asarray(d) for d in self._facet_data]
-                        + [jnp.zeros_like(jnp.asarray(self._facet_data[0]))]
-                        * n_pad
+                    base._place(
+                        np.stack(
+                            [np.asarray(d) for d in self._facet_data]
+                            + [np.zeros_like(np.asarray(self._facet_data[0]))]
+                            * n_pad
+                        )
                     ),
                 )
-        e0 = jnp.asarray(
+        e0 = base._place(
             (base.stack.offs0 - yB // 2).astype(np.int32)
         )
         col_offs0 = list(groups)
         G = self.col_group or self._auto_col_group(len(col_offs0))
-        samfn = _facet_pass_sampled_j(core)
+        if base.mesh is not None:
+            samfn = _facet_pass_sampled_sharded(core, base.mesh)
+        else:
+            samfn = _facet_pass_sampled_j(core)
         prev_tail = None  # backpressure marker: last column of group g-1
         for g0 in range(0, len(col_offs0), G):
             grp = col_offs0[g0 : g0 + G]
@@ -573,9 +753,11 @@ class StreamedForward:
                 NMBF = jax.lax.slice_in_dim(
                     buf, gi * m, (gi + 1) * m, axis=1
                 )
-                out = self._column_program(colfn, NMBF, groups[off0])
+                prog_items = groups[off0]  # incl. zero-mask padding
+                items = [it for it in prog_items if it[0] is not None]
+                out = self._column_program(colfn, NMBF, prog_items)
                 prev_tail = out
-                yield groups[off0], out
+                yield items, out
 
     def _auto_col_group(self, n_cols):
         """Largest column-group whose buffer + transients fit the budget.
@@ -593,7 +775,9 @@ class StreamedForward:
         base = self._base
         dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
         yB = base.stack.size
-        F = len(base.stack)
+        # On a mesh the facet stack and group buffer are sharded: budget
+        # against the facets PER DEVICE.
+        F = len(base.stack) // _mesh_size(base.mesh)
         budget = float(os.environ.get("SWIFTLY_HBM_BUDGET", 14e9))
         facets_b = F * yB * yB * dsize
         reserve = 3e9  # column-pass workspace + trig transients
@@ -655,14 +839,19 @@ class StreamedBackward:
                 [jnp.asarray(_to_host_layout(core, d)) for _, d in group]
             )
             sg_offs = jnp.asarray([(sg.off0, sg.off1) for sg, _ in group])
-            colfn = _column_pass_bwd_j(core, len(group), yB)
+            if base.mesh is not None:
+                colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
+            else:
+                colfn = _column_pass_bwd_j(core, len(group), yB)
             rows = colfn(
                 subgrids,
                 sg_offs,
                 base._foffs0,
                 base._foffs1,
-                jnp.asarray(base.stack.masks1, core._Fb.dtype),
-            )  # [F, m, yB]
+                base._place(
+                    np.asarray(base.stack.masks1, core._Fb.dtype)
+                ),
+            )  # [F, m, yB] (facet-sharded on a mesh)
             pad = base._yB_pad - yB
             if pad:
                 widths = [(0, 0), (0, 0), (0, pad)] + [
@@ -693,9 +882,12 @@ class StreamedBackward:
         col_offs0 = sorted(self._naf)
         if not col_offs0:
             raise RuntimeError("No subgrids were added")
-        finfn = _facet_pass_bwd_j(core, yB)
+        if base.mesh is not None:
+            finfn = _facet_pass_bwd_sharded(core, base.mesh, yB)
+        else:
+            finfn = _facet_pass_bwd_j(core, yB)
         col_offs0_j = jnp.asarray(col_offs0)
-        masks0 = jnp.asarray(stack.masks0, core._Fb.dtype)
+        masks0 = base._place(np.asarray(stack.masks0, core._Fb.dtype))
         facets = np.zeros(
             (len(stack), yB, yB) + _tail(core), dtype=_np_dtype(core)
         )
@@ -711,10 +903,11 @@ class StreamedBackward:
                     ]
                 )
             else:
-                blocks = jnp.asarray(
+                blocks = base._place(
                     np.stack(
                         [self._naf[o][:, :, j0 : j0 + Cb] for o in col_offs0]
-                    )
+                    ),
+                    facet_axis=1,
                 )
             out = finfn(blocks, col_offs0_j, base._foffs0, masks0)
             pending.append((j0, out))

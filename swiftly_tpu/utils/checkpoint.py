@@ -17,7 +17,12 @@ import json
 
 import numpy as np
 
-__all__ = ["save_backward_state", "restore_backward_state"]
+__all__ = [
+    "save_backward_state",
+    "restore_backward_state",
+    "save_streamed_backward_state",
+    "restore_streamed_backward_state",
+]
 
 _VERSION = 1
 
@@ -95,4 +100,81 @@ def restore_backward_state(path, backward):
             backward._MNAF_BMNAFs = _dev(data["MNAF_BMNAFs"])
         for key in meta["lru_keys"]:
             backward.lru.set(key, _dev(data[f"lru_{key}"]))
+        return [tuple(p) for p in meta["processed"]]
+
+
+def _check_meta(meta, core, n_total, kind):
+    if meta["version"] != _VERSION:
+        raise ValueError(f"Unsupported checkpoint version {meta['version']}")
+    # legacy files (written by save_backward_state before "kind" existed)
+    # default to "backward" so a cross-kind restore fails loudly here
+    if meta.get("kind", "backward") != kind:
+        raise ValueError(
+            f"Checkpoint holds {meta.get('kind')!r} state, expected {kind!r}"
+        )
+    expect = [core.W, core.N, core.xM_size, core.yN_size]
+    if meta["params"] != expect or meta["backend"] != core.backend:
+        raise ValueError(
+            f"Checkpoint was written for params {meta['params']} "
+            f"backend {meta['backend']!r}; this session has {expect} "
+            f"backend {core.backend!r}"
+        )
+    if meta["n_total"] != n_total:
+        raise ValueError("Facet stack size mismatch")
+
+
+def save_streamed_backward_state(path, backward, processed_subgrids=None):
+    """Snapshot a StreamedBackward session to `path` (.npz).
+
+    The streamed backward's whole state is its per-column NAF_BMNAF row
+    accumulators (`_naf`, one [F, m, yB_pad] array per seen column) —
+    the path actually used at 32k+ scale, where a killed run would
+    otherwise lose hours of accumulation.
+
+    :param backward: the StreamedBackward instance
+    :param processed_subgrids: optional list of (off0, off1) already folded
+        in, stored for the caller to skip on resume
+    """
+    core = backward.core
+    arrays = {}
+    meta = {
+        "version": _VERSION,
+        "kind": "streamed_backward",
+        "backend": core.backend,
+        "params": [core.W, core.N, core.xM_size, core.yN_size],
+        "n_real": backward.stack.n_real,
+        "n_total": backward.stack.n_total,
+        "residency": backward._base.residency,
+        "naf_keys": [],
+        "processed": list(map(list, processed_subgrids or [])),
+    }
+    for key, rows in backward._naf.items():
+        meta["naf_keys"].append(int(key))
+        arrays[f"naf_{int(key)}"] = np.asarray(rows)
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    )
+    np.savez(path, **arrays)
+
+
+def restore_streamed_backward_state(path, backward):
+    """Restore a snapshot into a freshly constructed StreamedBackward.
+
+    The instance must be built with the same config/facet list (and may
+    use either residency — accumulators are re-placed to match). Returns
+    the list of (off0, off1) subgrids already processed.
+    """
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+        core = backward.core
+        _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
+
+        device = backward._base.residency == "device"
+        for key in meta["naf_keys"]:
+            rows = data[f"naf_{key}"]
+            if device:
+                # facet-sharded on a mesh, plain device array otherwise
+                backward._naf[key] = backward._base._place(rows)
+            else:
+                backward._naf[key] = np.array(rows)
         return [tuple(p) for p in meta["processed"]]

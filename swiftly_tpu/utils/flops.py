@@ -1,0 +1,174 @@
+"""Analytic FLOP accounting for the planar matmul-FFT pipeline.
+
+Every compute op in the planar backend is an einsum (or elementwise op) of
+statically known shape, so the FLOP count of a whole transform is exact —
+no sampling or hardware counters needed. The bench reports effective
+TFLOP/s and % of the chip's published peak alongside the wall-clock, which
+turns `vs_baseline` (a soft single-core-numpy yardstick) into a hard
+hardware-utilisation number.
+
+Conventions: one multiply-add = 2 FLOPs; counts follow the default "4mul"
+complex-product algorithm (4 real matmuls per complex matmul,
+`planar_backend._cmatmul`); elementwise twiddle/phase/window multiplies are
+included (6 FLOPs per complex point) but are <1% of any total.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..ops.planar_backend import _DIRECT_MAX, _factor
+
+__all__ = [
+    "fft_flops",
+    "forward_batched_flops",
+    "forward_sampled_flops",
+    "backward_batched_flops",
+    "peak_tflops",
+]
+
+
+def fft_flops(n: int, batch: int) -> int:
+    """FLOPs of one planar matmul (i)FFT of size n over `batch` rows.
+
+    Direct (n <= 1024): 4 real [batch, n] x [n, n] matmuls.
+    Factored n = n1*n2: two matmul rounds (8*batch*n*(n1+n2)) plus the
+    elementwise twiddle (6 per complex point).
+    """
+    if n <= _DIRECT_MAX:
+        return 8 * batch * n * n
+    n1, n2 = _factor(n)
+    return 8 * batch * n * (n1 + n2) + 6 * batch * n
+
+
+def _per_subgrid_flops(core, subgrid_size: int, n_facets: int) -> int:
+    """FLOPs to turn one column's NMBF_BFs into one finished subgrid.
+
+    Per facet: add_to_subgrid axis 0 (fft size m over m rows) and axis 1
+    (fft size m over xM rows) plus the Fn windows; then one
+    finish_subgrid (ifft size xM over xM rows, crop, ifft size xM over
+    xA rows, crop).
+    """
+    m, xM = core.xM_yN_size, core.xM_size
+    per_facet = (
+        fft_flops(m, m) + 6 * m * m  # axis 0 fft + Fn window
+        + fft_flops(m, xM) + 6 * xM * m  # axis 1 fft + Fn window
+    )
+    finish = fft_flops(xM, xM) + fft_flops(xM, subgrid_size)
+    # facet-sum (2 adds per complex point per facet) + masks
+    reduce_mask = 2 * (n_facets - 1) * xM * xM + 4 * subgrid_size**2
+    return n_facets * per_facet + finish + reduce_mask
+
+
+def _column_prepare_flops(core, n_facets: int) -> int:
+    """Axis-1 preparation of one column's rows: per facet, Fb window +
+    ifft size yN over m rows."""
+    m, yN = core.xM_yN_size, core.yN_size
+    return n_facets * (fft_flops(yN, m) + 6 * m * yN)
+
+
+def forward_batched_flops(
+    core, n_facets: int, facet_size: int, n_columns: int,
+    subgrids_per_column: int, subgrid_size: int,
+) -> int:
+    """Total FLOPs of the batched whole-cover forward transform.
+
+    prepare_facets (once) + per-column extraction/preparation + per-subgrid
+    summation/finish — the exact op sequence of
+    `parallel.batched.forward_all_batch`.
+    """
+    yN = core.yN_size
+    prepare = n_facets * (fft_flops(yN, facet_size) + 6 * facet_size * yN)
+    columns = n_columns * _column_prepare_flops(core, n_facets)
+    subgrids = (
+        n_columns
+        * subgrids_per_column
+        * _per_subgrid_flops(core, subgrid_size, n_facets)
+    )
+    return prepare + columns + subgrids
+
+
+def forward_sampled_flops(
+    core, n_facets: int, facet_size: int, n_columns: int,
+    subgrids_per_column: int, subgrid_size: int,
+) -> int:
+    """Total FLOPs of the streamed device-resident (sampled-DFT) forward.
+
+    Facet pass: one [R, yB] x [F*yB, yB] complex matmul with R = C*m
+    sampled rows, plus the per-facet diagonal phase; column pass: same as
+    the batched path's per-column work.
+    """
+    yB = facet_size
+    m = core.xM_yN_size
+    R = n_columns * m
+    facet_pass = 8 * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
+    columns = n_columns * _column_prepare_flops(core, n_facets)
+    subgrids = (
+        n_columns
+        * subgrids_per_column
+        * _per_subgrid_flops(core, subgrid_size, n_facets)
+    )
+    return facet_pass + columns + subgrids
+
+
+def backward_batched_flops(
+    core, n_facets: int, facet_size: int, n_columns: int,
+    subgrids_per_column: int, subgrid_size: int,
+) -> int:
+    """Total FLOPs of the batched whole-cover backward transform.
+
+    Per subgrid: prepare_subgrid (two ffts) + per-facet extraction (two
+    iffts + Fn windows); per column: per-facet axis-1 finish
+    (fft size yN over m rows) + Fb window; finish: per-facet axis-0
+    finish (fft size yN over yB rows).
+    """
+    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+    prep = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
+    extract = n_facets * (
+        fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+    )
+    per_sg = prep + extract
+    col_fin = n_facets * (
+        fft_flops(yN, m) + 6 * m * facet_size
+    )
+    facet_fin = n_facets * (
+        fft_flops(yN, facet_size) + 6 * facet_size * yN
+    )
+    return (
+        n_columns * subgrids_per_column * per_sg
+        + n_columns * col_fin
+        + facet_fin
+    )
+
+
+# Published peak dense-matmul throughput, TFLOP/s. The planar pipeline runs
+# f32 einsums at Precision.HIGHEST (bf16x3/f32 accumulate on the MXU), so
+# the honest utilisation ceiling on TPU is the bf16 MXU peak divided by the
+# 3 bf16 passes HIGHEST costs; published bf16 peaks below.
+_PEAKS_BF16 = {
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v4": 275.0,
+    "TPU v6e": 918.0,
+}
+
+
+def peak_tflops(device=None) -> float | None:
+    """Peak f32-HIGHEST matmul TFLOP/s for the current device, or None.
+
+    Override with SWIFTLY_PEAK_TFLOPS (e.g. from a measured matmul
+    roofline) when the device is not in the table.
+    """
+    env = os.environ.get("SWIFTLY_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for name, bf16 in _PEAKS_BF16.items():
+        if name.lower() in str(kind).lower():
+            return bf16 / 3.0  # HIGHEST = 3 bf16 MXU passes
+    return None

@@ -198,3 +198,83 @@ def test_backward_all_numpy_fallback():
     for fc, facet in zip(facet_configs, facets):
         err = check_facet(config.image_size, fc, np.asarray(facet), SOURCES)
         assert err < 3e-10
+
+
+# ---------------------------------------------------------------------------
+# Ragged (sparse/irregular) covers through the fused + streamed paths
+# ---------------------------------------------------------------------------
+
+
+def _ragged_cover(subgrid_configs):
+    """Drop some subgrids so columns have unequal lengths."""
+    ragged = [
+        sg for i, sg in enumerate(subgrid_configs)
+        if i % 3 != 0 or i == 0
+    ]
+    cols = {}
+    for sg in ragged:
+        cols.setdefault(sg.off0, []).append(sg)
+    assert len({len(v) for v in cols.values()}) > 1  # really ragged
+    return ragged
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_forward_all_ragged_cover(backend):
+    """Ragged covers run through the fused path (zero-mask padding) and
+    match the per-subgrid streaming results exactly."""
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    ragged = _ragged_cover(subgrid_configs)
+    fwd_fused = SwiftlyForward(config, facet_tasks, 3, 64)
+    fused = np.asarray(fwd_fused.all_subgrids(ragged))
+    fwd_stream = SwiftlyForward(config, facet_tasks, 3, 64)
+    for i, sg in enumerate(ragged):
+        ref = np.asarray(fwd_stream.get_subgrid_task(sg))
+        np.testing.assert_allclose(fused[i], ref, atol=1e-13)
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_backward_all_ragged_cover(backend):
+    """Ragged covers through fused backward_all (zero-data padding) match
+    the streaming accumulators exactly."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
+    ragged = _ragged_cover(subgrid_configs)
+    fwd = SwiftlyForward(config, facet_tasks, 3, 64)
+    tasks = [(sg, fwd.get_subgrid_task(sg)) for sg in ragged]
+    fused = np.asarray(backward_all(config, facet_configs, tasks))
+    bwd = SwiftlyBackward(config, facet_configs, 3, 64)
+    for sg, data in tasks:
+        bwd.add_new_subgrid_task(sg, data)
+    ref = np.asarray(bwd.finish())
+    np.testing.assert_allclose(fused, ref, atol=1e-13)
+
+
+@pytest.mark.parametrize("residency", ["host", "device"])
+def test_streamed_ragged_cover(residency):
+    """Ragged covers stream column-by-column (padded program rows are
+    discarded) and match the batched per-subgrid results."""
+    from swiftly_tpu.parallel import StreamedForward
+
+    config, _, subgrid_configs, facet_tasks = _setup("jax")
+    ragged = _ragged_cover(subgrid_configs)
+    fwd = StreamedForward(config, facet_tasks, residency=residency)
+    out = fwd.all_subgrids(ragged)
+    assert out.shape[0] == len(ragged)
+    ref_fwd = SwiftlyForward(config, facet_tasks, 3, 64)
+    for i, sg in enumerate(ragged):
+        ref = np.asarray(ref_fwd.get_subgrid_task(sg))
+        np.testing.assert_allclose(out[i], ref, atol=1e-13)
+
+
+def test_forward_all_ragged_tail_padding():
+    """Only the last column short, inputs already column-ordered: output
+    must be trimmed to the request count (identity-order padding path)."""
+    config, _, subgrid_configs, facet_tasks = _setup("jax")
+    # column-ordered full cover minus the last column's last subgrids
+    ordered = sorted(subgrid_configs, key=lambda sg: (sg.off0, sg.off1))
+    ragged = ordered[:-2]
+    fwd = SwiftlyForward(config, facet_tasks, 3, 64)
+    out = np.asarray(fwd.all_subgrids(ragged))
+    assert out.shape[0] == len(ragged)
+    ref_fwd = SwiftlyForward(config, facet_tasks, 3, 64)
+    ref = np.asarray(ref_fwd.get_subgrid_task(ragged[-1]))
+    np.testing.assert_allclose(out[-1], ref, atol=1e-13)

@@ -123,3 +123,76 @@ def test_collective_bytes_analytic():
     assert fwd8 == 256 * 256 * 8 * 2 * 7  # ring all-reduce: 2*(d-1) buffers
     bwd8 = collective_bytes_backward(228, 8)
     assert bwd8 == 228 * 228 * 8 * 7  # planar f32 = 8 B/px, 7 receivers
+
+
+@pytest.mark.parametrize("residency", ["host", "device"])
+def test_streamed_checkpoint_resume_mid_stream(tmp_path, residency):
+    """Kill a StreamedBackward halfway, snapshot, restore, finish: the
+    facets must match an uninterrupted streamed run."""
+    from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency=residency)
+    columns = list(fwd.stream_columns(subgrid_configs))
+
+    def tasks(col):
+        items, subgrids = col
+        return [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+
+    # Uninterrupted reference
+    bwd_ref = StreamedBackward(config, facet_configs, residency=residency)
+    for col in columns:
+        bwd_ref.add_subgrids(tasks(col))
+    facets_ref = np.asarray(bwd_ref.finish())
+
+    # Interrupted: half the columns, snapshot, restore, rest, finish
+    half = len(columns) // 2
+    bwd1 = StreamedBackward(config, facet_configs, residency=residency)
+    done = []
+    for col in columns[:half]:
+        bwd1.add_subgrids(tasks(col))
+        done.extend((sg.off0, sg.off1) for _, sg in col[0])
+    ckpt = tmp_path / "streamed_bwd.npz"
+    save_streamed_backward_state(ckpt, bwd1, done)
+
+    bwd2 = StreamedBackward(config, facet_configs, residency=residency)
+    processed = set(restore_streamed_backward_state(ckpt, bwd2))
+    assert processed == set(done)
+    for col in columns[half:]:
+        bwd2.add_subgrids(tasks(col))
+    facets_resumed = np.asarray(bwd2.finish())
+
+    np.testing.assert_allclose(facets_resumed, facets_ref, atol=1e-13)
+
+
+def test_streamed_checkpoint_rejects_mismatch(tmp_path):
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    bwd = StreamedBackward(config, facet_configs)
+    bwd._naf[0] = np.zeros(
+        (len(bwd.stack), config.core.xM_yN_size, bwd._base._yB_pad),
+        dtype=complex,
+    )
+    ckpt = tmp_path / "bad.npz"
+    save_streamed_backward_state(ckpt, bwd)
+
+    other = SwiftlyConfig(backend="jax", **{**TEST_PARAMS, "W": 12.0})
+    bwd2 = StreamedBackward(other, make_full_facet_cover(other))
+    with pytest.raises(ValueError):
+        restore_streamed_backward_state(ckpt, bwd2)
