@@ -44,9 +44,10 @@ for group in 0 1 4 16; do
 done
 
 echo "sweep complete; summaries:"
-find "$ROOT" -name 'summary_*.json' -exec sh -c \
-  'python - "$1" <<"EOF"
+find "$ROOT" -name 'summary_*.json' | python -c '
 import json, sys
-s = json.load(open(sys.argv[1]))
-print(f"{sys.argv[1]}: {s[\"elapsed_s\"]}s, max RMS {s[\"max_facet_rms\"]:.2e}")
-EOF' _ {} \;
+for line in sys.stdin:
+    path = line.strip()
+    s = json.load(open(path))
+    print("%s: %ss, max RMS %.2e" % (path, s["elapsed_s"], s["max_facet_rms"]))
+'

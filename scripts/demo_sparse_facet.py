@@ -69,24 +69,49 @@ def demo_sparse(args, params):
         for fc in facet_configs
     ]
 
-    fwd = SwiftlyForward(config, facet_tasks, args.lru_forward,
-                         args.queue_size)
-    bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
-                          args.queue_size)
-
+    streamed = args.execution.startswith("streamed")
     t0 = time.time()
     sg_errors = []
-    for sg_config in subgrid_configs:
-        subgrid = fwd.get_subgrid_task(sg_config)
-        if args.check_subgrid:
-            sg_errors.append(
-                check_subgrid(
-                    config.image_size, sg_config,
-                    config.core.as_complex(subgrid), sources,
+    if streamed:
+        from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+
+        residency = (
+            "device" if args.execution == "streamed-device" else "host"
+        )
+        fwd = StreamedForward(
+            config, facet_tasks, residency=residency,
+            col_group=args.col_group or None,
+        )
+        bwd = StreamedBackward(config, facet_configs, residency=residency)
+        for items, subgrids in fwd.stream_columns(subgrid_configs):
+            if args.check_subgrid:
+                sg_errors.extend(
+                    check_subgrid(
+                        config.image_size, sg,
+                        config.core.as_complex(subgrids[s]), sources,
+                    )
+                    for s, (_, sg) in enumerate(items)
                 )
+            bwd.add_subgrids(
+                [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
             )
-        bwd.add_new_subgrid_task(sg_config, subgrid)
-    facets = bwd.finish()
+        facets = bwd.finish()
+    else:
+        fwd = SwiftlyForward(config, facet_tasks, args.lru_forward,
+                             args.queue_size)
+        bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
+                              args.queue_size)
+        for sg_config in subgrid_configs:
+            subgrid = fwd.get_subgrid_task(sg_config)
+            if args.check_subgrid:
+                sg_errors.append(
+                    check_subgrid(
+                        config.image_size, sg_config,
+                        config.core.as_complex(subgrid), sources,
+                    )
+                )
+            bwd.add_new_subgrid_task(sg_config, subgrid)
+        facets = bwd.finish()
     elapsed = time.time() - t0
     log.info("round trip: %.2fs (%.3fs/subgrid)", elapsed,
              elapsed / len(subgrid_configs))

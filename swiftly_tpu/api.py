@@ -35,7 +35,7 @@ from .models.covers import (
 )
 from .ops.oracle import make_facet_from_sources, make_subgrid_from_sources
 from .parallel import batched, sharded
-from .parallel.mesh import pad_to_shards
+from .parallel.mesh import mesh_size as _mesh_size, pad_to_shards
 
 log = logging.getLogger("swiftly-tpu")
 
@@ -245,8 +245,6 @@ class _FacetStack:
         return self.n_total
 
 
-def _mesh_size(mesh):
-    return 1 if mesh is None else mesh.devices.size
 
 
 def _place(core, mesh, arr, shard_facets: bool):

@@ -17,6 +17,7 @@ FACET_AXIS = "facet"
 __all__ = [
     "FACET_AXIS",
     "facet_sharding",
+    "mesh_size",
     "initialize_multihost",
     "make_facet_mesh",
     "pad_to_shards",
@@ -50,6 +51,11 @@ def facet_sharding(mesh: Mesh) -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully replicated sharding on the mesh."""
     return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_size(mesh) -> int:
+    """Device count of a (possibly absent) mesh."""
+    return 1 if mesh is None else mesh.devices.size
 
 
 def varying(x, axis_name: str):

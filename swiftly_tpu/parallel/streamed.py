@@ -100,11 +100,7 @@ try:  # jax >= 0.6 exports shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map  # noqa: E402
 
-from .mesh import FACET_AXIS, varying  # noqa: E402
-
-
-def _mesh_size(mesh):
-    return 1 if mesh is None else mesh.devices.size
+from .mesh import FACET_AXIS, mesh_size as _mesh_size, varying  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -168,9 +164,13 @@ def _facet_pass_fwd_sharded(core, mesh):
 def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
 
-    With `axis_name`, F is the local facet shard and the per-column
-    reduction finishes with ONE psum over the stacked [S, xM, xM]
-    partials — the streamed pipeline's only collective.
+    The facet reduction is a lax.scan accumulating one [S, xM, xM]
+    buffer (each step: one facet's contributions to ALL S subgrids,
+    S-batched matmuls) — a vmap-over-S-of-sum-over-F materialises every
+    (S, F) contribution block at once, which OOMs a 16 GiB chip at the
+    32k scale. With `axis_name`, F is the local facet shard and the
+    reduction finishes with ONE psum over the accumulated partials —
+    the streamed pipeline's only collective.
     """
     p = core._p
 
@@ -180,15 +180,24 @@ def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
 
         NMBF_BF = jax.vmap(prep1)(NMBF, foffs1)  # [F, m, yN]
 
-        def partial(sg_off_pair):
-            contrib = lambda bf, f0, f1: facet_contrib_to_subgrid(
-                core, bf, f0, f1, sg_off_pair[1]
-            )
-            return jax.numpy.sum(
-                jax.vmap(contrib)(NMBF_BF, foffs0, foffs1), axis=0
-            )
+        def facet_step(acc, xs):
+            bf, f0, f1 = xs
+            per_sg = jax.vmap(
+                lambda so: facet_contrib_to_subgrid(core, bf, f0, f1, so[1])
+            )(sg_offs)  # [S, xM, xM]
+            return acc + per_sg, None
 
-        partials = jax.vmap(partial)(sg_offs)  # [S, xM, xM] (local facets)
+        S = sg_offs.shape[0]
+        init = jax.numpy.zeros(
+            (S, core.xM_size, core.xM_size) + NMBF.shape[3:],
+            dtype=NMBF.dtype,
+        )
+        if axis_name is not None:
+            # the carry mixes in facet-sharded offsets: tag it varying
+            init = varying(init, axis_name)
+        partials, _ = jax.lax.scan(
+            facet_step, init, (NMBF_BF, foffs0, foffs1)
+        )
         if axis_name is not None:
             partials = jax.lax.psum(partials, axis_name)
 
@@ -211,6 +220,51 @@ def _column_pass_fwd_j(core, subgrid_size):
 def _column_pass_fwd_sharded(core, mesh, subgrid_size):
     return _shmap(
         _column_pass_fwd_fn(core, subgrid_size, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
+            _P(), _P(), _P(),
+        ),
+        out_specs=_P(),
+    )
+
+
+def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
+    """Sampled group buffer [F, G*m, yB] -> subgrids [G, S, xA, xA].
+
+    vmaps the column pass over a whole sampled-DFT group: one dispatch
+    per G columns instead of G, and the per-subgrid small-matmul stages
+    gain a G-times larger batch dimension (the column pass is MXU-
+    utilisation-bound at m-sized tiles, measured ~2.7 TFLOP/s per
+    column alone on v5e).
+    """
+    m = core.xM_yN_size
+    colfn = _column_pass_fwd_fn(core, subgrid_size, axis_name)
+
+    def fn(buf, foffs0, foffs1, sg_offs_g, masks0_g, masks1_g):
+        F = buf.shape[0]
+        G = sg_offs_g.shape[0]
+        NMBF_g = jax.numpy.moveaxis(
+            buf.reshape((F, G, m) + buf.shape[2:]), 1, 0
+        )  # [G, F, m, yB(,2)]
+
+        def per_col(NMBF, so, m0, m1):
+            return colfn(NMBF, foffs0, foffs1, so, m0, m1)
+
+        return jax.vmap(per_col)(NMBF_g, sg_offs_g, masks0_g, masks1_g)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _column_pass_fwd_group_j(core, subgrid_size):
+    return _jit()(_column_pass_fwd_group_fn(core, subgrid_size))
+
+
+@functools.lru_cache(maxsize=None)
+def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
+    return _shmap(
+        _column_pass_fwd_group_fn(core, subgrid_size, axis_name=FACET_AXIS),
+        mesh,
         in_specs=(
             _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
             _P(), _P(), _P(),
@@ -247,7 +301,7 @@ def _column_pass_bwd_fn(core, facet_size, axis_name=None):
 
 
 @functools.lru_cache(maxsize=None)
-def _column_pass_bwd_j(core, n_subgrids, facet_size):
+def _column_pass_bwd_j(core, facet_size):
     return _jit()(_column_pass_bwd_fn(core, facet_size))
 
 
@@ -487,6 +541,11 @@ class _StreamedBase:
         self._yB_pad = self._n_blocks * self.col_block
         self._foffs0 = self._place(np.asarray(self.stack.offs0))
         self._foffs1 = self._place(np.asarray(self.stack.offs1))
+        rdt = self.core._Fb.dtype
+        # realised once: per-call conversion/upload would sit on the hot
+        # per-column accumulation path
+        self._masks0_dev = self._place(np.asarray(self.stack.masks0, rdt))
+        self._masks1_dev = self._place(np.asarray(self.stack.masks1, rdt))
 
     def _place(self, arr, facet_axis: int = 0):
         """Upload an array, facet-sharding `facet_axis` over the mesh (or
@@ -649,13 +708,15 @@ class StreamedForward:
         subgrid_configs = list(subgrid_configs)
         groups = _group_full_columns(subgrid_configs)
         size = subgrid_configs[0].size
-        if self._base.mesh is not None:
-            colfn = _column_pass_fwd_sharded(self.core, self._base.mesh, size)
-        else:
-            colfn = _column_pass_fwd_j(self.core, size)
         if self._base.residency == "device":
-            gen = self._device_columns(groups, colfn)
+            gen = self._device_columns(groups, size)
         else:
+            if self._base.mesh is not None:
+                colfn = _column_pass_fwd_sharded(
+                    self.core, self._base.mesh, size
+                )
+            else:
+                colfn = _column_pass_fwd_j(self.core, size)
             gen = self._host_columns(groups, colfn)
         if device_arrays:
             yield from gen
@@ -682,21 +743,21 @@ class StreamedForward:
             NMBF = self._nmbf_column(self._col_index[int(off0)])
             yield items, self._column_program(colfn, NMBF, prog_items)
 
-    def _device_columns(self, groups, colfn):
+    def _device_columns(self, groups, subgrid_size):
         """Facets-resident sampled-DFT pass in column groups.
 
         Facets upload ONCE and stay on device; each group of G columns'
         contribution rows is one einsum dispatch (compute proportional to
-        the rows extracted, so chunking is free); nothing round-trips
+        the rows extracted, so chunking is free), and the group's G
+        column passes run as ONE vmapped dispatch; nothing round-trips
         through the host. Device residency = facets + one [F, G*m, yB]
-        group buffer.
+        group buffer + two in-flight [G, S, xA, xA] output stacks.
         """
         import jax
         import jax.numpy as jnp
 
         base = self._base
         core = base.core
-        m = core.xM_yN_size
         yB = base.stack.size
         n_pad = base.stack.n_total - base.stack.n_real
         if self._dev_facets is None:
@@ -732,9 +793,16 @@ class StreamedForward:
         G = self.col_group or self._auto_col_group(len(col_offs0))
         if base.mesh is not None:
             samfn = _facet_pass_sampled_sharded(core, base.mesh)
+            gcolfn = _column_pass_fwd_group_sharded(
+                core, base.mesh, subgrid_size
+            )
         else:
             samfn = _facet_pass_sampled_j(core)
-        prev_tail = None  # backpressure marker: last column of group g-1
+            gcolfn = _column_pass_fwd_group_j(core, subgrid_size)
+        from ..api import _subgrid_masks
+
+        rdt = core._Fb.dtype
+        prev_tail = None  # backpressure marker: group g-1's output stack
         for g0 in range(0, len(col_offs0), G):
             grp = col_offs0[g0 : g0 + G]
             # pad a short final group to the full G (row indices repeat the
@@ -742,48 +810,62 @@ class StreamedForward:
             # shape would trigger a full recompile of the sampled program
             grp_padded = grp + [grp[-1]] * (G - len(grp))
             krows = jnp.asarray(sampled_row_indices(core, grp_padded))
-            # JAX dispatch is asynchronous: without a wait the host loop
-            # races ahead and every group buffer stays live at once
-            # (OOM). Blocking on the previous group's tail bounds the
-            # in-flight set to two group buffers.
-            if prev_tail is not None:
-                jax.block_until_ready(prev_tail)
-            buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
-            for gi, off0 in enumerate(grp):
-                NMBF = jax.lax.slice_in_dim(
-                    buf, gi * m, (gi + 1) * m, axis=1
-                )
+            sg_offs_g, m0_g, m1_g = [], [], []
+            for off0 in grp_padded:
                 prog_items = groups[off0]  # incl. zero-mask padding
+                sg_offs_g.append(
+                    [(sg.off0, sg.off1) for _, sg in prog_items]
+                )
+                ms = [_subgrid_masks(sg) for _, sg in prog_items]
+                m0_g.append([mk[0] for mk in ms])
+                m1_g.append([mk[1] for mk in ms])
+            # JAX dispatch is asynchronous: without a wait the host loop
+            # races ahead and every group buffer stays live at once,
+            # overcommitting HBM. The wait must be a genuine host
+            # round-trip — on the tunnel-attached TPU runtime here,
+            # block_until_ready returns before the queue drains, so pull
+            # an 8-byte checksum of the previous group instead.
+            if prev_tail is not None:
+                np.asarray(prev_tail)
+            buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
+            out_g = gcolfn(
+                buf,
+                base._foffs0,
+                base._foffs1,
+                jnp.asarray(sg_offs_g),
+                jnp.asarray(np.asarray(m0_g), rdt),
+                jnp.asarray(np.asarray(m1_g), rdt),
+            )  # [G, S, xA, xA(,2)]
+            prev_tail = jnp.sum(out_g)
+            for gi, off0 in enumerate(grp):
+                prog_items = groups[off0]
                 items = [it for it in prog_items if it[0] is not None]
-                out = self._column_program(colfn, NMBF, prog_items)
-                prev_tail = out
-                yield items, out
+                yield items, out_g[gi]
 
     def _auto_col_group(self, n_cols):
         """Largest column-group whose buffer + transients fit the budget.
 
-        HBM budget via SWIFTLY_HBM_BUDGET (bytes, default 14e9); on CPU
-        the full column set is one group.
+        HBM budget: SWIFTLY_HBM_BUDGET (bytes) if set, else 90% of the
+        device's reported capacity (`memory_stats()["bytes_limit"]`),
+        else 14e9. On CPU the full column set is one group.
         """
         import os
 
         import jax
 
-        if jax.devices()[0].platform == "cpu":
+        device = jax.devices()[0]
+        if device.platform == "cpu":
             return n_cols
-        core = self.core
-        base = self._base
-        dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
-        yB = base.stack.size
-        # On a mesh the facet stack and group buffer are sharded: budget
-        # against the facets PER DEVICE.
-        F = len(base.stack) // _mesh_size(base.mesh)
-        budget = float(os.environ.get("SWIFTLY_HBM_BUDGET", 14e9))
-        facets_b = F * yB * yB * dsize
-        reserve = 3e9  # column-pass workspace + trig transients
-        col_b = 2 * F * core.xM_yN_size * yB * dsize  # buffer + A matrix
-        G = int((budget - facets_b - reserve) // col_b)
-        return max(1, min(n_cols, G))
+        env = os.environ.get("SWIFTLY_HBM_BUDGET")
+        if env:
+            budget = float(env)
+        else:
+            try:
+                limit = (device.memory_stats() or {}).get("bytes_limit", 0)
+            except Exception:  # pragma: no cover - backend-specific
+                limit = 0
+            budget = 0.9 * limit if limit else 14e9
+        return col_group_for_budget(self._base, budget, n_cols)
 
     def all_subgrids(self, subgrid_configs):
         """Every subgrid, in request order, as one host array [n, xA, xA]."""
@@ -797,6 +879,37 @@ class StreamedForward:
             for s, (i, _) in enumerate(items):
                 out[i] = subgrids[s]
         return out
+
+
+def col_group_for_budget(base, budget, n_cols):
+    """Largest sampled-DFT column-group G whose working set fits `budget`
+    bytes on one device (facet stack + per-G transients).
+
+    Live per unit G (measured OOM at 32k taught this accounting):
+      - sampled buffer [F, m, yB] + its in-program [G,F,m,yB]
+        transpose + the einsum operand            -> 3 * F*m*yB
+      - prep1 output [F, m, yN] inside the column pass -> F*m*yN
+      - two in-flight output stacks [S, xA, xA]   -> 2 * S*xA^2
+      - per-subgrid padded partials [S, xM, xM]   -> S*xM^2
+    On a mesh the facet stack and group buffer are sharded: facets count
+    PER DEVICE.
+    """
+    core = base.core
+    dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
+    yB = base.stack.size
+    F = len(base.stack) // _mesh_size(base.mesh)
+    facets_b = F * yB * yB * dsize
+    reserve = 2e9  # trig tables, fragmentation, small transients
+    m = core.xM_yN_size
+    xA = base.config.max_subgrid_size
+    xM = core.xM_size
+    S = -(-core.N // xA)
+    col_b = (
+        3 * F * m * yB + F * m * core.yN_size
+        + 2 * S * xA * xA + S * xM * xM
+    ) * dsize
+    G = int((budget - facets_b - reserve) // col_b)
+    return max(1, min(n_cols, G))
 
 
 # ---------------------------------------------------------------------------
@@ -842,15 +955,13 @@ class StreamedBackward:
             if base.mesh is not None:
                 colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
             else:
-                colfn = _column_pass_bwd_j(core, len(group), yB)
+                colfn = _column_pass_bwd_j(core, yB)
             rows = colfn(
                 subgrids,
                 sg_offs,
                 base._foffs0,
                 base._foffs1,
-                base._place(
-                    np.asarray(base.stack.masks1, core._Fb.dtype)
-                ),
+                base._masks1_dev,
             )  # [F, m, yB] (facet-sharded on a mesh)
             pad = base._yB_pad - yB
             if pad:
@@ -887,7 +998,7 @@ class StreamedBackward:
         else:
             finfn = _facet_pass_bwd_j(core, yB)
         col_offs0_j = jnp.asarray(col_offs0)
-        masks0 = base._place(np.asarray(stack.masks0, core._Fb.dtype))
+        masks0 = base._masks0_dev
         facets = np.zeros(
             (len(stack), yB, yB) + _tail(core), dtype=_np_dtype(core)
         )

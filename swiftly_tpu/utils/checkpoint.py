@@ -145,6 +145,7 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
         "n_real": backward.stack.n_real,
         "n_total": backward.stack.n_total,
         "residency": backward._base.residency,
+        "yB_pad": backward._base._yB_pad,
         "naf_keys": [],
         "processed": list(map(list, processed_subgrids or [])),
     }
@@ -168,6 +169,21 @@ def restore_streamed_backward_state(path, backward):
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         core = backward.core
         _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
+        # older snapshots (same _VERSION) did not record yB_pad; the rows
+        # arrays carry it as their last data axis either way
+        saved_pad = meta.get("yB_pad")
+        if saved_pad is None and meta["naf_keys"]:
+            # rows are [F, m, yB_pad] (+ trailing planar pair axis)
+            saved_pad = data[f"naf_{meta['naf_keys'][0]}"].shape[2]
+        if saved_pad is not None and saved_pad != backward._base._yB_pad:
+            # rows are stored at the saving session's col_block padding;
+            # a different padding would make finish() slice garbage
+            raise ValueError(
+                f"Checkpoint rows are padded to yB_pad={meta['yB_pad']} "
+                f"(col_block of the saving session); this session uses "
+                f"{backward._base._yB_pad} — construct StreamedBackward "
+                f"with the same col_block"
+            )
 
         device = backward._base.residency == "device"
         for key in meta["naf_keys"]:

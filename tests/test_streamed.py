@@ -239,3 +239,19 @@ def test_streamed_mesh_facets_sharded():
     assert len(facets.sharding.device_set) == 8
     # 9 real facets padded to 16 -> 2 per device
     assert facets.shape[0] == 16
+
+
+def test_col_group_budget_accounting():
+    """The sampled-group sizer must fit facets + per-G transients in the
+    budget (the 32k G=4 OOM regression)."""
+    from swiftly_tpu.parallel.streamed import col_group_for_budget
+
+    config, _, _, facet_tasks = _setup("jax")
+    fwd = StreamedForward(config, facet_tasks)
+    # huge budget -> capped by n_cols; tiny budget -> floor of 1
+    assert col_group_for_budget(fwd._base, 1e15, 7) == 7
+    assert col_group_for_budget(fwd._base, 1.0, 7) == 1
+    # monotone in budget
+    gs = [col_group_for_budget(fwd._base, b, 10**6)
+          for b in (1e9, 4e9, 16e9, 64e9)]
+    assert gs == sorted(gs)

@@ -196,3 +196,25 @@ def test_streamed_checkpoint_rejects_mismatch(tmp_path):
     bwd2 = StreamedBackward(other, make_full_facet_cover(other))
     with pytest.raises(ValueError):
         restore_streamed_backward_state(ckpt, bwd2)
+
+
+def test_streamed_checkpoint_rejects_col_block_mismatch(tmp_path):
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    bwd = StreamedBackward(config, facet_configs, col_block=512)
+    bwd._naf[0] = np.zeros(
+        (len(bwd.stack), config.core.xM_yN_size, bwd._base._yB_pad),
+        dtype=complex,
+    )
+    ckpt = tmp_path / "cb.npz"
+    save_streamed_backward_state(ckpt, bwd)
+
+    bwd2 = StreamedBackward(config, facet_configs, col_block=100)
+    with pytest.raises(ValueError, match="col_block"):
+        restore_streamed_backward_state(ckpt, bwd2)
