@@ -17,14 +17,17 @@ Prints ONE JSON line per configuration; the LAST line is the headline
 metric (the north-star large-N config).
 
 Environment knobs:
-  BENCH_CONFIGS  comma-separated "name:mode" entries (mode batched|streamed;
-                 default "4k[1]-n2k-512:batched,32k[1]-n16k-512:streamed")
+  BENCH_CONFIGS  comma-separated "name:mode" entries; modes:
+                 batched | roundtrip | streamed (default: 4k batched,
+                 4k round-trip, 32k streamed — the headline, last)
   BENCH_CONFIG / BENCH_MODE  legacy single-config override
 
 Modes: "batched" keeps the prepared facet stack resident and runs the
-whole cover as one fused program; "streamed" uses the facets-resident
-sampled-DFT column groups (for configs whose prepared facet stack exceeds
-HBM, e.g. 32k+ on a 16 GiB chip).
+whole cover as one fused program; "roundtrip" additionally feeds every
+subgrid back through the fused backward transform and checks the facet
+round-trip RMS (the reference demo's end-to-end shape); "streamed" uses
+the facets-resident sampled-DFT column groups (for configs whose
+prepared facet stack exceeds HBM, e.g. 32k+ on a 16 GiB chip).
 """
 
 import json
@@ -123,18 +126,26 @@ def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed):
         peak_tflops,
     )
 
+    from swiftly_tpu.utils.flops import backward_batched_flops
+
     core = config.core
     n_cols = len({sg.off0 for sg in subgrid_configs})
     per_col = len(subgrid_configs) // n_cols
-    fn = forward_sampled_flops if mode == "streamed" else forward_batched_flops
-    flops = fn(
-        core,
+    kwargs = dict(
         n_facets=len(facet_configs),
         facet_size=facet_configs[0].size,
         n_columns=n_cols,
         subgrids_per_column=per_col,
         subgrid_size=subgrid_configs[0].size,
     )
+    if mode == "streamed":
+        flops = forward_sampled_flops(core, **kwargs)
+    elif mode == "roundtrip":
+        flops = forward_batched_flops(core, **kwargs) + backward_batched_flops(
+            core, **kwargs
+        )
+    else:
+        flops = forward_batched_flops(core, **kwargs)
     fields = {"tflops": round(flops / elapsed / 1e12, 2)}
     peak = peak_tflops()
     if peak:
@@ -144,8 +155,20 @@ def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed):
 
 def run_one(config_name, mode):
     import jax
+    import jax.numpy as jnp
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
+
+    if mode not in ("batched", "roundtrip", "streamed"):
+        raise ValueError(
+            f"Unknown bench mode {mode!r} (batched|roundtrip|streamed)"
+        )
+
+    def force(arr):
+        """Force completion via an 8-byte checksum pull — load-bearing:
+        the tunnel runtime's block_until_ready returns before the queue
+        drains (see run_streamed)."""
+        return float(np.asarray(jnp.sum(arr)))
 
     params = dict(SWIFT_CONFIGS[config_name])
     params.setdefault("fov", 1.0)
@@ -194,14 +217,30 @@ def run_one(config_name, mode):
             )
             for sgc, d in kept.values()
         )
+    elif mode == "roundtrip":
+        from swiftly_tpu import backward_all, check_facet
+
+        def run_roundtrip():
+            subgrids = fwd.all_subgrids(subgrid_configs)
+            facets = backward_all(
+                config, facet_configs,
+                [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)],
+            )
+            force(facets)
+            return facets
+
+        run_roundtrip()  # warmup: compile both fused programs
+        t0 = time.time()
+        facets = run_roundtrip()
+        elapsed = time.time() - t0
+        rms = max(
+            check_facet(
+                config.image_size, fc,
+                config.core.as_complex(np.asarray(facets[i])), sources,
+            )
+            for i, fc in enumerate(facet_configs)
+        )
     else:
-        import jax.numpy as jnp
-
-        def force(arr):
-            """Force completion via an 8-byte checksum pull (see
-            run_streamed)."""
-            return float(np.asarray(jnp.sum(arr)))
-
         # Warmup: compile + run the fused whole-cover program once
         force(fwd.all_subgrids(subgrid_configs))
 
@@ -237,7 +276,7 @@ def run_one(config_name, mode):
         # (sampling consecutive subgrids of an already-warm column would
         # exclude extraction entirely; sampling one subgrid per column
         # would charge it S times over).
-        _, fwd_np, _, sg_np, _ = _build("numpy", params)
+        cfg_np, fwd_np, fc_np, sg_np, _ = _build("numpy", params)
         fwd_np.get_subgrid_task(sg_np[0])
         col1 = [sg for sg in sg_np if sg.off0 != sg_np[0].off0]
         if col1:
@@ -247,12 +286,35 @@ def run_one(config_name, mode):
             # extraction cost is then excluded, a conservative estimate
             column = sg_np[1:] or sg_np
         t0 = time.time()
-        for sg in column:
-            fwd_np.get_subgrid_task(sg)
+        tasks_np = [(sg, fwd_np.get_subgrid_task(sg)) for sg in column]
         numpy_total = (time.time() - t0) / len(column) * len(sg_np)
+        if mode == "roundtrip":
+            from swiftly_tpu import SwiftlyBackward
 
+            n_cols = len({sg.off0 for sg in sg_np})
+            bwd_np = SwiftlyBackward(cfg_np, fc_np)
+            t0 = time.time()
+            bwd_np.add_new_subgrid_tasks(tasks_np)
+            numpy_total += (time.time() - t0) / len(column) * len(sg_np)
+            # finish() = ONE column fold (a full cover pays K of those)
+            # + the final per-facet finishes (paid once); isolate the
+            # fold by timing an empty finish (identical final shapes)
+            t0 = time.time()
+            bwd_np.finish()
+            t_fin = time.time() - t0
+            bwd_empty = SwiftlyBackward(cfg_np, fc_np)
+            t0 = time.time()
+            bwd_empty.finish()
+            t_fin_empty = time.time() - t0
+            t_fold = max(0.0, t_fin - t_fin_empty)
+            numpy_total += t_fold * n_cols + t_fin_empty
+
+    direction = (
+        "forward+backward round-trip" if mode == "roundtrip"
+        else "forward facet->subgrid"
+    )
     result = {
-        "metric": f"{config_name} forward facet->subgrid wall-clock "
+        "metric": f"{config_name} {direction} wall-clock "
                   f"({len(subgrid_configs)} subgrids, planar f32, "
                   f"{mode}, {platform})",
         "value": round(elapsed, 4),
@@ -280,7 +342,8 @@ def main():
     else:
         spec = os.environ.get(
             "BENCH_CONFIGS",
-            "4k[1]-n2k-512:batched,32k[1]-n16k-512:streamed",
+            "4k[1]-n2k-512:batched,4k[1]-n2k-512:roundtrip,"
+            "32k[1]-n16k-512:streamed",
         )
         entries = []
         for item in spec.split(","):
