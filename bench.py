@@ -59,7 +59,10 @@ def _build(backend, params, dtype=None, streamed=False):
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
-        fwd = StreamedForward(config, facet_tasks, residency="device")
+        col_group = int(os.environ.get("BENCH_COL_GROUP", "0")) or None
+        fwd = StreamedForward(
+            config, facet_tasks, residency="device", col_group=col_group
+        )
     else:
         fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
                              queue_size=64)

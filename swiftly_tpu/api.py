@@ -255,12 +255,14 @@ def _place(core, mesh, arr, shard_facets: bool):
     if mesh is None:
         return arr
     import jax
-    from .parallel.mesh import facet_sharding, replicated_sharding
+    from .parallel.mesh import place_facet_sharded, replicated_sharding
 
     if np.iscomplexobj(arr):
         arr = core._prep(np.asarray(arr))
-    sharding = facet_sharding(mesh) if shard_facets else replicated_sharding(mesh)
-    return jax.device_put(arr, sharding)
+    if shard_facets:
+        # multihost-safe: each process supplies only its facet shard
+        return place_facet_sharded(arr, mesh)
+    return jax.device_put(arr, replicated_sharding(mesh))
 
 
 def _use_shard_map(config):

@@ -19,6 +19,7 @@ __all__ = [
     "facet_sharding",
     "mesh_size",
     "initialize_multihost",
+    "place_facet_sharded",
     "make_facet_mesh",
     "pad_to_shards",
     "replicated_sharding",
@@ -74,6 +75,28 @@ def pad_to_shards(n: int, n_shards: int) -> int:
     Zero-padded facets contribute zeros to every linear accumulation, so
     padding is exact (not approximate)."""
     return ((n + n_shards - 1) // n_shards) * n_shards
+
+
+def place_facet_sharded(arr, mesh: Mesh, facet_axis: int = 0):
+    """Place the GLOBAL array `arr` facet-sharded over the mesh,
+    multihost-safely.
+
+    Single-process: a plain `device_put` with the facet sharding. On a
+    multi-host pod slice (jax.process_count() > 1) a global device_put
+    would address devices this process cannot reach; instead each
+    process materialises only its addressable shards of the global host
+    array (`jax.make_array_from_callback` slices them out), so no
+    cross-host transfer of the stack ever happens.
+    """
+    arr = np.asarray(arr)
+    spec = [None] * arr.ndim
+    spec[facet_axis] = FACET_AXIS
+    sharding = NamedSharding(mesh, PartitionSpec(*spec))
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
 
 
 def initialize_multihost(coordinator=None, num_processes=None, process_id=None):

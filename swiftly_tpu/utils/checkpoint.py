@@ -90,10 +90,11 @@ def restore_backward_state(path, backward):
             if mesh is not None:
                 # Restore the facet-sharded layout the accumulators were
                 # created with (api._place); without this a mesh session
-                # resumes with everything on one device.
-                from ..parallel.mesh import facet_sharding
+                # resumes with everything on one device. Multihost-safe
+                # (each process touches only its addressable shards).
+                from ..parallel.mesh import place_facet_sharded
 
-                arr = jax.device_put(arr, facet_sharding(mesh))
+                arr = place_facet_sharded(np.asarray(arr), mesh)
             return arr
 
         if meta["has_mnaf"]:
