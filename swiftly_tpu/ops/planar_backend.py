@@ -108,8 +108,9 @@ from .primitives import wrapped_embed, wrapped_extract  # noqa: E402
 
 
 def _factor(n: int):
-    """Split n = n1*n2 with both factors <= _DIRECT_MAX, n1 >= n2, and n1
-    as small as possible (most balanced split)."""
+    """Split n = n1*n2 with both factors <= _DIRECT_MAX, taking the
+    LARGEST valid n1 (smallest n2): the n1-sized DFT matmul carries the
+    FLOPs, so big-n1 splits keep the contraction long and MXU-friendly."""
     best = None
     for n2 in range(2, int(np.sqrt(n)) + 1):
         if n % n2 == 0:

@@ -1,6 +1,20 @@
-"""Utilities: profiling, memory accounting, compilation cache, logging."""
+"""Utilities: profiling, memory accounting, FLOP models, checkpointing,
+compilation cache."""
 
 from .cache import enable_compilation_cache
+from .checkpoint import (
+    restore_backward_state,
+    restore_streamed_backward_state,
+    save_backward_state,
+    save_streamed_backward_state,
+)
+from .flops import (
+    backward_batched_flops,
+    fft_flops,
+    forward_batched_flops,
+    forward_sampled_flops,
+    peak_tflops,
+)
 from .profiling import (
     MemorySampler,
     collective_bytes_backward,
@@ -11,9 +25,18 @@ from .profiling import (
 
 __all__ = [
     "MemorySampler",
+    "backward_batched_flops",
     "collective_bytes_backward",
     "collective_bytes_forward",
     "device_memory_stats",
     "enable_compilation_cache",
+    "fft_flops",
+    "forward_batched_flops",
+    "forward_sampled_flops",
+    "peak_tflops",
+    "restore_backward_state",
+    "restore_streamed_backward_state",
+    "save_backward_state",
+    "save_streamed_backward_state",
     "trace",
 ]

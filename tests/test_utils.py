@@ -218,3 +218,45 @@ def test_streamed_checkpoint_rejects_col_block_mismatch(tmp_path):
     bwd2 = StreamedBackward(config, facet_configs, col_block=100)
     with pytest.raises(ValueError, match="col_block"):
         restore_streamed_backward_state(ckpt, bwd2)
+
+
+def test_fft_flops_model():
+    """Analytic FLOP model matches hand counts for direct and factored
+    sizes (the bench's TFLOP/s and MFU numbers rest on these)."""
+    from swiftly_tpu.utils.flops import fft_flops
+
+    # direct (n <= 1024): 4 real [B, n] x [n, n] matmuls, 2 flops/MAC
+    assert fft_flops(256, 7) == 8 * 7 * 256 * 256
+    assert fft_flops(1024, 1) == 8 * 1024 * 1024
+    # factored n = n1*n2 (_factor picks the LARGEST n1 <= 1024):
+    # 2048 = 1024*2 -> 8*B*n*(n1+n2) + 6*B*n twiddle
+    assert fft_flops(2048, 3) == 8 * 3 * 2048 * (1024 + 2) + 6 * 3 * 2048
+    # 16384 = 1024 * 16
+    assert fft_flops(16384, 1) == 8 * 16384 * (1024 + 16) + 6 * 16384
+
+
+def test_forward_flops_scale():
+    """Total forward FLOPs scale linearly in subgrid count and the
+    sampled path charges the einsum instead of per-block FFT prep."""
+    from swiftly_tpu import SWIFT_CONFIGS, SwiftlyConfig
+    from swiftly_tpu.utils.flops import (
+        backward_batched_flops,
+        forward_batched_flops,
+        forward_sampled_flops,
+    )
+
+    params = dict(SWIFT_CONFIGS["1k[1]-n512-256"])
+    params.setdefault("fov", 1.0)
+    core = SwiftlyConfig(backend="jax", **params).core
+    kwargs = dict(n_facets=9, facet_size=416, n_columns=7,
+                  subgrids_per_column=7, subgrid_size=228)
+    f1 = forward_batched_flops(core, **kwargs)
+    f2 = forward_batched_flops(core, **{**kwargs, "subgrids_per_column": 14})
+    f3 = forward_batched_flops(core, **{**kwargs, "subgrids_per_column": 21})
+    assert f2 > f1
+    assert f3 - f2 == f2 - f1  # linear in subgrid count
+    # all three totals are positive and the same order of magnitude
+    fs = forward_sampled_flops(core, **kwargs)
+    fb = backward_batched_flops(core, **kwargs)
+    assert 0.1 < fs / f1 < 10
+    assert 0.1 < fb / f1 < 10
