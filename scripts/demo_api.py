@@ -88,13 +88,24 @@ def demo_api(args, params, config_name=""):
     else:
         fwd = SwiftlyForward(config, facet_tasks, args.lru_forward,
                              args.queue_size)
-        bwd = SwiftlyBackward(config, facet_configs, args.lru_backward,
-                              args.queue_size)
+        # the fused mode's backward_all builds its own state
+        bwd = None if args.execution == "fused" else SwiftlyBackward(
+            config, facet_configs, args.lru_backward, args.queue_size
+        )
 
     sampler = MemorySampler()
     t0 = time.time()
     with trace(args.profile_dir), sampler.sample():
-        if streamed:
+        if args.execution == "fused":
+            from swiftly_tpu import backward_all
+
+            subgrids = fwd.all_subgrids(subgrid_configs)
+            # identity "processing" step sits here in a real pipeline
+            facets = backward_all(
+                config, facet_configs,
+                [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)],
+            )
+        elif streamed:
             done = 0
             for items, subgrids in fwd.stream_columns(subgrid_configs):
                 # identity "processing" step sits here in a real pipeline
@@ -158,7 +169,7 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
 
     out = Path(args.artifact_dir)
     out.mkdir(parents=True, exist_ok=True)
-    tag = (config_name or "run").replace("/", "_")
+    tag = f"{config_name or 'run'}-{args.execution}".replace("/", "_")
     mem_csv = out / f"mem_{tag}.csv"
     sampler.to_csv(mem_csv)
 

@@ -72,7 +72,26 @@ def demo_sparse(args, params):
     streamed = args.execution.startswith("streamed")
     t0 = time.time()
     sg_errors = []
-    if streamed:
+    if args.execution == "fused":
+        from swiftly_tpu import backward_all
+
+        fwd = SwiftlyForward(
+            config, facet_tasks, args.lru_forward, args.queue_size
+        )
+        subgrids = fwd.all_subgrids(subgrid_configs)
+        if args.check_subgrid:
+            sg_errors.extend(
+                check_subgrid(
+                    config.image_size, sg,
+                    config.core.as_complex(subgrids[i]), sources,
+                )
+                for i, sg in enumerate(subgrid_configs)
+            )
+        facets = backward_all(
+            config, facet_configs,
+            [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)],
+        )
+    elif streamed:
         from swiftly_tpu.parallel import StreamedBackward, StreamedForward
 
         residency = (

@@ -79,9 +79,11 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
         "--execution",
         type=str,
         default="batched",
-        choices=["batched", "streamed", "streamed-device"],
-        help="execution strategy: 'batched' keeps prepared facets "
-             "device-resident (fastest when they fit HBM); 'streamed' "
+        choices=["batched", "fused", "streamed", "streamed-device"],
+        help="execution strategy: 'batched' streams subgrid-by-subgrid "
+             "with prepared facets device-resident; 'fused' runs the "
+             "whole cover as ONE forward program and ONE backward "
+             "program (fastest when everything fits HBM); 'streamed' "
              "buffers column intermediates in host RAM (out-of-core); "
              "'streamed-device' keeps raw facets resident and computes "
              "column groups by sampled DFT (large N on one chip, no "
