@@ -18,7 +18,7 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from scripts.utils import cli_parser, make_sources, setup_jax
+from scripts.utils import cli_parser, setup_jax
 
 log = logging.getLogger("swiftly-tpu.demo-sparse")
 

@@ -157,13 +157,20 @@ class LRUCache:
 
 
 class FlightQueue:
-    """Bounds in-flight asynchronous device work.
+    """Bounds in-flight asynchronous device work, counted in LOGICAL
+    TASKS (subgrids), not bytes.
 
     JAX dispatches computations asynchronously; unbounded dispatch can
     enqueue arbitrarily much device work and host memory. `admit` blocks on
     the oldest in-flight result once `depth` computations are outstanding —
-    the streaming analogue of the reference's TaskQueue
-    (api.py:466-522).
+    the streaming analogue of the reference's TaskQueue (api.py:466-522),
+    whose unit is also a task. Batched/fused paths admit one slot per
+    subgrid even when many subgrids share one program's output array, so
+    `queue_size` keeps its meaning across execution paths; byte-level
+    control is the sharding layout plus the streamed executors'
+    HBM-budgeted group sizing (`col_group_for_budget`). Note the
+    tunnel-runtime caveat: where `block_until_ready` returns early, the
+    streamed paths use checksum-pull backpressure instead of this queue.
     """
 
     def __init__(self, depth: int):

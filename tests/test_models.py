@@ -6,7 +6,6 @@ import pytest
 from swiftly_tpu import SWIFT_CONFIGS, SwiftlyConfig
 from swiftly_tpu.models import (
     FacetConfig,
-    make_full_cover,
     make_full_facet_cover,
     make_full_subgrid_cover,
     make_sparse_facet_cover,
