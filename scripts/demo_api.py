@@ -172,6 +172,8 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
     tag = f"{config_name or 'run'}-{args.execution}".replace("/", "_")
     mem_csv = out / f"mem_{tag}.csv"
     sampler.to_csv(mem_csv)
+    report_html = out / f"report_{tag}.html"
+    sampler.to_html(report_html, title=f"{config_name} {args.execution}")
 
     n_dev = 1 if mesh is None else mesh.devices.size
     planar = config.core.backend == "planar"
@@ -212,6 +214,7 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
             for dev, stats in mem_stats.items()
         },
         "memory_csv": str(mem_csv),
+        "report_html": str(report_html),
     }
     summary_path = out / f"summary_{tag}.json"
     summary_path.write_text(json.dumps(summary, indent=2))

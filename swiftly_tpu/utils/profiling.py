@@ -109,6 +109,68 @@ class MemorySampler:
             for t, dev, b in self.rows:
                 fh.write(f"{t:.3f},{dev},{b}\n")
 
+    def to_html(self, path, title="device memory"):
+        """Self-contained HTML report: an inline-SVG memory timeline per
+        device (the analogue of the reference demo's Dask
+        performance-report HTML, reference demo_api.py:127-133)."""
+        import html as _html
+
+        title = _html.escape(str(title))
+        by_dev = {}
+        for t, dev, b in self.rows:
+            by_dev.setdefault(str(dev), []).append((t, b))
+        t_max = max((t for t, _, _ in self.rows), default=1.0) or 1.0
+        b_max = max((b for _, _, b in self.rows), default=1) or 1
+        W, H, PAD = 800, 240, 40
+        # legend column to the right of the plot so labels never overlap
+        # the curves, however many devices there are
+        LEG = 180
+        colors = ["#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e"]
+        parts = [
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{title}</title></head><body>"
+            f"<h2>{title}</h2>"
+            f"<p>peak {b_max / 2**30:.2f} GiB over {t_max:.1f} s</p>"
+            f"<svg width='{W + LEG}' height='{H}' "
+            "style='background:#fafafa;border:1px solid #ccc'>"
+        ]
+        for i, (dev, pts) in enumerate(sorted(by_dev.items())):
+            coords = [
+                (
+                    PAD + (W - 2 * PAD) * t / t_max,
+                    H - PAD - (H - 2 * PAD) * b / b_max,
+                )
+                for t, b in pts
+            ]
+            c = colors[i % len(colors)]
+            if len(coords) == 1:
+                # a one-point polyline renders nothing: draw a dot
+                x, y = coords[0]
+                parts.append(
+                    f"<circle cx='{x:.1f}' cy='{y:.1f}' r='3' "
+                    f"fill='{c}'/>"
+                )
+            else:
+                poly = " ".join(f"{x:.1f},{y:.1f}" for x, y in coords)
+                parts.append(
+                    f"<polyline points='{poly}' fill='none' stroke='{c}' "
+                    f"stroke-width='1.5'/>"
+                )
+            parts.append(
+                f"<text x='{W + 8}' y='{16 + 14 * i}' fill='{c}' "
+                f"font-size='12'>{_html.escape(dev)}</text>"
+            )
+        parts.append(
+            f"<text x='{PAD}' y='{H - 8}' font-size='11'>0 s</text>"
+            f"<text x='{W - PAD - 30}' y='{H - 8}' font-size='11'>"
+            f"{t_max:.0f} s</text>"
+            f"<text x='2' y='{PAD}' font-size='11'>"
+            f"{b_max / 2**30:.1f} GiB</text>"
+            "</svg></body></html>"
+        )
+        with open(path, "w") as fh:
+            fh.write("".join(parts))
+
 
 def _itemsize(dtype, planar: bool) -> int:
     size = np.dtype(dtype).itemsize

@@ -260,3 +260,30 @@ def test_forward_flops_scale():
     fb = backward_batched_flops(core, **kwargs)
     assert 0.1 < fs / f1 < 10
     assert 0.1 < fb / f1 < 10
+
+
+def test_memory_sampler_html_report(tmp_path):
+    """The HTML report is self-contained and plots every device."""
+    sampler = MemorySampler()
+    sampler.rows = [
+        (0.0, "dev0", 100), (1.0, "dev0", 200),
+        (0.0, "dev1", 50), (1.0, "dev1", 150),
+    ]
+    path = tmp_path / "report.html"
+    sampler.to_html(path, title="test run")
+    html = path.read_text()
+    assert "<svg" in html and "polyline" in html
+    assert "dev0" in html and "dev1" in html
+    assert "test run" in html
+
+
+def test_memory_sampler_html_single_sample_and_escaping(tmp_path):
+    """One-sample devices render a visible mark; title/devices escape."""
+    sampler = MemorySampler()
+    sampler.rows = [(0.0, "dev<0>", 100)]
+    path = tmp_path / "one.html"
+    sampler.to_html(path, title="a<b & c")
+    html = path.read_text()
+    assert "<circle" in html  # single point -> dot, not invisible polyline
+    assert "a&lt;b &amp; c" in html
+    assert "dev&lt;0&gt;" in html
