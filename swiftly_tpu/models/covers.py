@@ -114,7 +114,7 @@ def _rows_for_fov(facet_size: int, fov_pixels: int, N: int):
     rows = []
 
     def chord(off1_up):
-        if off1_up == 0 or (n_rows % 2 == 1 and off1_up == 0):
+        if off1_up == 0:
             return fov_pixels
         return 2 * math.sqrt(
             max((fov_pixels / 2) ** 2 - (off1_up - facet_size / 2) ** 2, 0.0)

@@ -44,6 +44,7 @@ import numpy as np
 from ..ops.core import prepare_subgrid_math
 from .batched import (
     _accumulate_facet_fn,
+    _as_real,
     _extract_columns_fn,
     _finish_facets_fn,
     _split_accumulate_fn,
@@ -276,8 +277,8 @@ def forward_all_sharded(
         jnp.asarray(offs1),
         jnp.asarray(col_offs0),
         jnp.asarray(sg_offs1),
-        jnp.asarray(np.asarray(masks0), rdt),
-        jnp.asarray(np.asarray(masks1), rdt),
+        _as_real(masks0, rdt),
+        _as_real(masks1, rdt),
     )
 
 
@@ -395,6 +396,6 @@ def backward_all_sharded(
         jnp.asarray(np.asarray(sg_offs)),
         jnp.asarray(offs0),
         jnp.asarray(offs1),
-        jnp.asarray(np.asarray(masks0), rdt),
-        jnp.asarray(np.asarray(masks1), rdt),
+        _as_real(masks0, rdt),
+        _as_real(masks1, rdt),
     )

@@ -38,6 +38,7 @@ def save_backward_state(path, backward, processed_subgrids=None):
     arrays = {}
     meta = {
         "version": _VERSION,
+        "kind": "backward",
         "backend": core.backend,
         "params": [core.W, core.N, core.xM_size, core.yN_size],
         "n_real": backward.stack.n_real,
@@ -65,18 +66,8 @@ def restore_backward_state(path, backward):
     """
     with np.load(path) as data:
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
-        if meta["version"] != _VERSION:
-            raise ValueError(f"Unsupported checkpoint version {meta['version']}")
         core = backward.core
-        expect = [core.W, core.N, core.xM_size, core.yN_size]
-        if meta["params"] != expect or meta["backend"] != core.backend:
-            raise ValueError(
-                f"Checkpoint was written for params {meta['params']} "
-                f"backend {meta['backend']!r}; this session has {expect} "
-                f"backend {core.backend!r}"
-            )
-        if meta["n_total"] != backward.stack.n_total:
-            raise ValueError("Facet stack size mismatch")
+        _check_meta(meta, core, backward.stack.n_total, "backward")
 
         mesh = getattr(backward, "mesh", None)
 
@@ -180,7 +171,7 @@ def restore_streamed_backward_state(path, backward):
             # rows are stored at the saving session's col_block padding;
             # a different padding would make finish() slice garbage
             raise ValueError(
-                f"Checkpoint rows are padded to yB_pad={meta['yB_pad']} "
+                f"Checkpoint rows are padded to yB_pad={saved_pad} "
                 f"(col_block of the saving session); this session uses "
                 f"{backward._base._yB_pad} — construct StreamedBackward "
                 f"with the same col_block"

@@ -18,10 +18,18 @@ equivalents:
 from __future__ import annotations
 
 import contextlib
+import logging
 import threading
 import time
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# one-shot flag: warn the first time every device reports empty stats so
+# operators know the memory artifacts they are writing carry no data
+# (e.g. tunnel-attached runtimes that hide memory_stats()).
+_warned_empty_stats = False
 
 __all__ = [
     "MemorySampler",
@@ -61,6 +69,14 @@ def device_memory_stats() -> dict:
             stats[str(dev)] = dev.memory_stats() or {}
         except Exception:  # pragma: no cover - backend-specific
             stats[str(dev)] = {}
+    global _warned_empty_stats
+    if not _warned_empty_stats and not any(stats.values()):
+        _warned_empty_stats = True
+        logger.warning(
+            "memory_stats() is empty on every device (%s) — memory "
+            "reports/CSVs from this run will contain only zeros",
+            ", ".join(stats) or "no devices",
+        )
     return stats
 
 
