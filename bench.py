@@ -18,16 +18,24 @@ metric (the north-star large-N config).
 
 Environment knobs:
   BENCH_CONFIGS  comma-separated "name:mode" entries; modes:
-                 batched | roundtrip | streamed (default: 4k batched,
-                 4k round-trip, 32k streamed — the headline, last)
+                 batched | roundtrip | streamed | roundtrip-streamed
+                 (default: 4k batched, 4k round-trip, 32k streamed,
+                 32k round-trip-streamed, 64k streamed — headline last)
   BENCH_CONFIG / BENCH_MODE  legacy single-config override
+  BENCH_COL_GROUP / BENCH_FACET_GROUP / BENCH_FOLD_GROUP  streamed-path
+                 sizing overrides (default: HBM-budget auto)
 
 Modes: "batched" keeps the prepared facet stack resident and runs the
 whole cover as one fused program; "roundtrip" additionally feeds every
 subgrid back through the fused backward transform and checks the facet
 round-trip RMS (the reference demo's end-to-end shape); "streamed" uses
-the facets-resident sampled-DFT column groups (for configs whose
-prepared facet stack exceeds HBM, e.g. 32k+ on a 16 GiB chip).
+the sampled-DFT column groups with device-resident facets — or, when
+the stack exceeds HBM (64k+ on a 16 GiB chip), facet-slab streaming
+with exact cross-slab accumulation; "roundtrip-streamed" feeds the
+streamed forward's device columns straight into the sampled-residency
+backward (adjoint einsum) and verifies the reproduced facets on device.
+Streamed accuracy is checked on >= max(100, 2%) oracle subgrids via
+device-side residuals (n_rms_samples in the output records the count).
 """
 
 import json
@@ -52,21 +60,74 @@ def _build(backend, params, dtype=None, streamed=False):
     facet_configs = make_full_facet_cover(config)
     subgrid_configs = make_full_subgrid_cover(config)
     sources = [(1.0, 1, 0)]
-    facet_tasks = [
-        (fc, make_facet(config.image_size, fc, sources))
-        for fc in facet_configs
-    ]
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
+        # lazy facet construction: StreamedForward converts each facet to
+        # its compact layout (real plane) one at a time — at 64k this
+        # bounds host peak to ONE 8 GB complex facet + the f32 planes,
+        # instead of the full 73 GB complex stack
+        facet_tasks = [
+            (fc, (lambda fc=fc: make_facet(config.image_size, fc, sources)))
+            for fc in facet_configs
+        ]
         col_group = int(os.environ.get("BENCH_COL_GROUP", "0")) or None
+        facet_group = int(os.environ.get("BENCH_FACET_GROUP", "0")) or None
         fwd = StreamedForward(
-            config, facet_tasks, residency="device", col_group=col_group
+            config, facet_tasks, residency="device", col_group=col_group,
+            facet_group=facet_group,
         )
     else:
+        facet_tasks = [
+            (fc, make_facet(config.image_size, fc, sources))
+            for fc in facet_configs
+        ]
         fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
                              queue_size=64)
     return config, fwd, facet_configs, subgrid_configs, sources
+
+
+def _oracle_sample_stack(config, subgrid_configs, sources, min_n=100,
+                         target_pct=2.0):
+    """Device-resident oracle subgrids for >= max(min_n, target_pct%) of
+    the cover, spread evenly, + the index map.
+
+    The accuracy check at 32k+ scale: residuals are computed ON DEVICE
+    against these uploaded references (d2h on tunnel-attached chips runs
+    at ~10 MB/s, so pulling subgrids to compare host-side would dominate
+    the benchmark)."""
+    import jax.numpy as jnp
+
+    from swiftly_tpu import make_subgrid
+
+    n = len(subgrid_configs)
+    n_s = min(n, max(min_n, int(n * target_pct / 100)))
+    stride = max(1, n // n_s)
+    idxs = list(range(0, n, stride))
+    core = config.core
+    host = []
+    for i in idxs:
+        ref = make_subgrid(config.image_size, subgrid_configs[i], sources)
+        if core.backend == "planar":
+            rdt = np.dtype(core.dtype)
+            host.append(
+                np.stack(
+                    [ref.real.astype(rdt), ref.imag.astype(rdt)], axis=-1
+                )
+            )
+        else:
+            host.append(np.asarray(ref, dtype=core.dtype))
+    return {i: k for k, i in enumerate(idxs)}, jnp.asarray(np.stack(host))
+
+
+def _rms2_device(core, got, want):
+    """Mean |residual|^2 of one subgrid/facet pair, on device."""
+    import jax.numpy as jnp
+
+    res = got - want
+    if core.backend == "planar":
+        return jnp.mean(jnp.sum(res * res, axis=-1))
+    return jnp.mean(jnp.abs(res) ** 2)
 
 
 def _numpy_baseline_from_parts(params, sources):
@@ -121,7 +182,20 @@ def _numpy_baseline_from_parts(params, sources):
     return t_prepare + t_col + t_sg
 
 
-def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed):
+def _cover_kwargs(facet_configs, subgrid_configs):
+    """The cover-shape arguments every flops-model call takes."""
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    return dict(
+        n_facets=len(facet_configs),
+        facet_size=facet_configs[0].size,
+        n_columns=n_cols,
+        subgrids_per_column=len(subgrid_configs) // n_cols,
+        subgrid_size=subgrid_configs[0].size,
+    )
+
+
+def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed,
+                 real_facets=False, finish_passes=1):
     """Analytic FLOP count -> tflops / mfu_pct fields."""
     from swiftly_tpu.utils.flops import (
         forward_batched_flops,
@@ -132,17 +206,19 @@ def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed):
     from swiftly_tpu.utils.flops import backward_batched_flops
 
     core = config.core
-    n_cols = len({sg.off0 for sg in subgrid_configs})
-    per_col = len(subgrid_configs) // n_cols
-    kwargs = dict(
-        n_facets=len(facet_configs),
-        facet_size=facet_configs[0].size,
-        n_columns=n_cols,
-        subgrids_per_column=per_col,
-        subgrid_size=subgrid_configs[0].size,
-    )
+    kwargs = _cover_kwargs(facet_configs, subgrid_configs)
     if mode == "streamed":
-        flops = forward_sampled_flops(core, **kwargs)
+        flops = forward_sampled_flops(
+            core, real_facets=real_facets, finish_passes=finish_passes,
+            **kwargs,
+        )
+    elif mode == "roundtrip-streamed":
+        from swiftly_tpu.utils.flops import backward_sampled_flops
+
+        flops = forward_sampled_flops(
+            core, real_facets=real_facets, finish_passes=finish_passes,
+            **kwargs,
+        ) + backward_sampled_flops(core, **kwargs)
     elif mode == "roundtrip":
         flops = forward_batched_flops(core, **kwargs) + backward_batched_flops(
             core, **kwargs
@@ -162,9 +238,11 @@ def run_one(config_name, mode):
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
 
-    if mode not in ("batched", "roundtrip", "streamed"):
+    if mode not in ("batched", "roundtrip", "streamed",
+                    "roundtrip-streamed"):
         raise ValueError(
-            f"Unknown bench mode {mode!r} (batched|roundtrip|streamed)"
+            f"Unknown bench mode {mode!r} "
+            "(batched|roundtrip|streamed|roundtrip-streamed)"
         )
 
     def force(arr):
@@ -179,47 +257,128 @@ def run_one(config_name, mode):
     dtype = jax.numpy.float32
 
     # --- accelerated run (planar backend) --------------------------------
+    streamed_mode = mode in ("streamed", "roundtrip-streamed")
     config, fwd, facet_configs, subgrid_configs, sources = _build(
-        "planar", params, dtype, streamed=(mode == "streamed")
+        "planar", params, dtype, streamed=streamed_mode
     )
-
-    def run_streamed():
-        """Full cover via sampled-DFT column groups; outputs consumed on
-        device (device->host bandwidth is not part of the transform).
-
-        Completion is forced through a device-side checksum that depends
-        on EVERY column's output, then one 8-byte pull — blocking on the
-        last output alone under-reports on runtimes whose
-        block_until_ready does not imply whole-queue completion (the
-        tunnel-attached TPU here)."""
-        import jax.numpy as jnp
-
-        kept = {}
-        acc = None
-        step = max(1, len(subgrid_configs) // 5)
-        for items, out in fwd.stream_columns(
-            subgrid_configs, device_arrays=True
-        ):
-            s = jnp.sum(out)
-            acc = s if acc is None else acc + s
-            for srow, (i, sgc) in enumerate(items):
-                if i % step == 0:
-                    kept[i] = (sgc, out[srow])
-        float(np.asarray(acc))
-        return kept
+    extra = {}
+    finish_passes = 1
+    real_facets = getattr(fwd, "_facets_real", False)
 
     if mode == "streamed":
-        kept = run_streamed()  # warmup: compile + facet upload
-        t0 = time.time()
-        kept = run_streamed()
-        elapsed = time.time() - t0
-        rms = max(
-            check_subgrid(
-                config.image_size, sgc,
-                config.core.as_complex(np.asarray(d)), sources,
-            )
-            for sgc, d in kept.values()
+        import jax.numpy as jnp
+
+        sample_map, oracle_dev = _oracle_sample_stack(
+            config, subgrid_configs, sources
         )
+        # the resident oracle stack shrinks the budget the auto-sizers see
+        fwd.hbm_headroom = int(oracle_dev.nbytes)
+
+        def run_streamed():
+            """Full cover via sampled-DFT column groups; outputs consumed
+            on device (device->host bandwidth is not part of the
+            transform) and verified on device against the uploaded
+            oracle samples.
+
+            Completion is forced through a device-side checksum that
+            depends on EVERY column's output, then one 8-byte pull —
+            blocking on the last output alone under-reports on runtimes
+            whose block_until_ready does not imply whole-queue completion
+            (the tunnel-attached TPU here)."""
+            acc = None
+            max_rms2 = jnp.zeros((), dtype=jnp.float32)
+            for items, out in fwd.stream_columns(
+                subgrid_configs, device_arrays=True
+            ):
+                s = jnp.sum(out)
+                acc = s if acc is None else acc + s
+                for srow, (i, sgc) in enumerate(items):
+                    k = sample_map.get(i)
+                    if k is not None:
+                        max_rms2 = jnp.maximum(
+                            max_rms2,
+                            _rms2_device(
+                                config.core, out[srow], oracle_dev[k]
+                            ),
+                        )
+            float(np.asarray(acc))
+            return float(np.asarray(max_rms2)) ** 0.5
+
+        run_streamed()  # warmup: compile + facet upload
+        t0 = time.time()
+        rms = run_streamed()
+        elapsed = time.time() - t0
+        extra["n_rms_samples"] = len(sample_map)
+        extra["rms_sample_pct"] = round(
+            100 * len(sample_map) / len(subgrid_configs), 2
+        )
+        plan = fwd.last_plan or {}
+        extra["facets_real"] = fwd._facets_real
+        extra["plan"] = plan
+        finish_passes = plan.get("n_slabs", 1)
+    elif mode == "roundtrip-streamed":
+        import jax.numpy as jnp
+
+        from swiftly_tpu.parallel import StreamedBackward
+
+        fold_group = int(os.environ.get("BENCH_FOLD_GROUP", "4"))
+
+        def run_roundtrip_streamed():
+            """StreamedForward -> sampled-residency StreamedBackward,
+            entirely on device: forward columns feed the backward's
+            adjoint-einsum accumulator, the finished facets are compared
+            on device with the forward's own resident facet planes (the
+            round trip must reproduce its input), and one scalar pull
+            forces completion of the whole graph."""
+            bwd = StreamedBackward(
+                config, facet_configs, residency="sampled",
+                fold_group=fold_group,
+            )
+            for items, out in fwd.stream_columns(
+                subgrid_configs, device_arrays=True
+            ):
+                bwd.add_subgrid_stack(
+                    [sg for _, sg in items], out[: len(items)]
+                )
+            facets_dev = bwd.finish_device()
+            n_real = fwd.stack.n_real
+            if fwd._dev_facets is not None and fwd._facets_real:
+                ref = fwd._dev_facets[0]
+                res_re = facets_dev[:n_real, :, :, 0] - ref[:n_real]
+                res_im = facets_dev[:n_real, :, :, 1]
+                rms2 = jnp.mean(
+                    res_re * res_re + res_im * res_im, axis=(1, 2)
+                )
+            else:
+                # re-upload per-facet references (grouped forward or
+                # complex facets: no resident copy to compare against)
+                rms2s = []
+                for i in range(n_real):
+                    ref = jnp.asarray(
+                        fwd._facet_data[i]
+                        if not fwd._facets_real
+                        else np.stack(
+                            [fwd._facet_data[i],
+                             np.zeros_like(fwd._facet_data[i])],
+                            axis=-1,
+                        )
+                    )
+                    rms2s.append(
+                        _rms2_device(config.core, facets_dev[i], ref)
+                    )
+                rms2 = jnp.stack(rms2s)
+            return float(np.asarray(jnp.max(rms2))) ** 0.5
+
+        run_roundtrip_streamed()  # warmup: compile both directions
+        t0 = time.time()
+        rms = run_roundtrip_streamed()
+        elapsed = time.time() - t0
+        extra["n_rms_samples"] = len(facet_configs)
+        extra["rms_check"] = "all facets, device-side vs input facets"
+        extra["facets_real"] = fwd._facets_real
+        plan = fwd.last_plan or {}
+        extra["plan"] = plan
+        finish_passes = plan.get("n_slabs", 1)
     elif mode == "roundtrip":
         from swiftly_tpu import backward_all, check_facet
 
@@ -267,9 +426,21 @@ def run_one(config_name, mode):
         )
 
     # --- numpy reference baseline ----------------------------------------
-    baseline_estimated = mode == "streamed"
+    baseline_estimated = streamed_mode
     if baseline_estimated:
         numpy_total = _numpy_baseline_from_parts(params, sources)
+        if mode == "roundtrip-streamed":
+            # extrapolate the backward leg by the analytic FLOP ratio of
+            # the two directions (their op sequences are duals with the
+            # same matmul-FFT shapes); flagged baseline_estimated
+            from swiftly_tpu.utils.flops import (
+                backward_batched_flops as _bb,
+                forward_batched_flops as _fb,
+            )
+
+            kw = _cover_kwargs(facet_configs, subgrid_configs)
+            core = config.core
+            numpy_total *= 1.0 + _bb(core, **kw) / _fb(core, **kw)
     else:
         # Warm one subgrid first so the one-time facet preparation is
         # excluded from the sample, as the planar run's warmup does. Then
@@ -313,7 +484,8 @@ def run_one(config_name, mode):
             numpy_total += t_fold * n_cols + t_fin_empty
 
     direction = (
-        "forward+backward round-trip" if mode == "roundtrip"
+        "forward+backward round-trip"
+        if mode in ("roundtrip", "roundtrip-streamed")
         else "forward facet->subgrid"
     )
     result = {
@@ -328,8 +500,12 @@ def run_one(config_name, mode):
         "baseline_estimated": baseline_estimated,
         "n_subgrids": len(subgrid_configs),
     }
+    result.update(extra)
     result.update(
-        _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed)
+        _flop_fields(
+            config, facet_configs, subgrid_configs, mode, elapsed,
+            real_facets=real_facets, finish_passes=finish_passes,
+        )
     )
     return result
 
@@ -346,7 +522,9 @@ def main():
         spec = os.environ.get(
             "BENCH_CONFIGS",
             "4k[1]-n2k-512:batched,4k[1]-n2k-512:roundtrip,"
-            "32k[1]-n16k-512:streamed",
+            "32k[1]-n16k-512:streamed,"
+            "32k[1]-n16k-512:roundtrip-streamed,"
+            "64k[1]-n32k-512:streamed",
         )
         entries = []
         for item in spec.split(","):

@@ -76,6 +76,27 @@ def _np_dtype(core):
     return np.dtype(core.dtype)
 
 
+def _real_plane_or_none(core, data):
+    """The facet's real plane as [yB, yB] float, or None if it has any
+    imaginary content (or the backend is not planar).
+
+    Point-source facet models are exactly real; detecting that here lets
+    the sampled-DFT path store/upload HALF the bytes and skip half its
+    einsums. One full host-side pass over the data — the same cost the
+    planar layout conversion pays anyway.
+    """
+    if not _planar(core):
+        return None
+    data = np.asarray(data)
+    if data.ndim and data.shape[-1] == 2 and not np.iscomplexobj(data):
+        if np.any(data[..., 1]):
+            return None
+        return np.asarray(data[..., 0], dtype=_np_dtype(core))
+    if np.iscomplexobj(data) and np.any(data.imag):
+        return None
+    return np.asarray(data.real, dtype=_np_dtype(core))
+
+
 def _to_host_layout(core, data):
     """One facet/subgrid as a host numpy array in device layout."""
     if _planar(core):
@@ -419,7 +440,7 @@ def _mulmod(a, b, yN):
 
 
 @functools.lru_cache(maxsize=None)
-def _facet_pass_sampled_fn(core):
+def _facet_pass_sampled_fn(core, real_facets=False):
     """facets [F, yB, Y(,2)] -> sampled contribution rows [F, R, Y(,2)].
 
     `krows` are centred spectral indices (from `sampled_row_indices`),
@@ -427,6 +448,14 @@ def _facet_pass_sampled_fn(core):
     per call; works for the full column set or any chunk of it. Body
     builder shared by the single-device jit and the facet-sharded
     shard_map variant.
+
+    With ``real_facets`` (planar backend only) the facets arrive as a
+    single real plane [F, yB, yB] — the zero imaginary plane's two
+    einsums are dropped, halving both the FLOPs and the facet upload
+    volume. Exact, not an approximation: point-source facet models are
+    real-valued (reference ``make_facet_from_sources``), and the caller
+    verifies the imaginary plane is identically zero before choosing
+    this path.
     """
     import jax.numpy as jnp
 
@@ -436,7 +465,41 @@ def _facet_pass_sampled_fn(core):
         theta = (2 * np.pi / yN) * residues
         return jnp.cos(theta), jnp.sin(theta)
 
-    if _planar(core):
+    if real_facets:
+        if not _planar(core):  # pragma: no cover - guarded by caller
+            raise ValueError("real_facets requires the planar backend")
+
+        def fn(Fr, e0, krows):
+            yB = Fr.shape[1]
+            dt = Fr.dtype
+            fb = core._p.extract_mid(core._Fb, yB, 0) / yN  # [yB] real
+            j = jnp.arange(yB, dtype=jnp.int32)
+            a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
+            A_re = (a_cos * fb[None, :]).astype(dt)
+            A_im = (a_sin * fb[None, :]).astype(dt)
+            from ..ops.planar_backend import _PRECISION
+
+            f = lambda a, b: jnp.einsum(
+                "rj,fjc->frc", a, b, precision=_PRECISION
+            )
+            out_re = f(A_re, Fr)
+            out_im = f(A_im, Fr)
+            p_cos, p_sin = phases(
+                _mulmod(
+                    e0.astype(jnp.int32)[:, None], krows[None, :], yN
+                )
+            )  # [F, R]
+            p_cos = p_cos.astype(dt)[..., None]
+            p_sin = p_sin.astype(dt)[..., None]
+            return jnp.stack(
+                [
+                    out_re * p_cos - out_im * p_sin,
+                    out_re * p_sin + out_im * p_cos,
+                ],
+                axis=-1,
+            )
+
+    elif _planar(core):
         # Planes arrive as SEPARATE arrays (Fr, Fi), not a trailing axis:
         # slicing a stacked [F, yB, yB, 2] inside the program would
         # materialise multi-GiB plane copies next to the resident stack.
@@ -492,22 +555,199 @@ def _facet_pass_sampled_fn(core):
 
 
 @functools.lru_cache(maxsize=None)
-def _facet_pass_sampled_j(core):
-    return _jit()(_facet_pass_sampled_fn(core))
+def _facet_pass_sampled_j(core, real_facets=False):
+    return _jit()(_facet_pass_sampled_fn(core, real_facets))
 
 
 @functools.lru_cache(maxsize=None)
-def _facet_pass_sampled_sharded(core, mesh):
+def _facet_pass_sampled_sharded(core, mesh, real_facets=False):
     """Facet-sharded sampled-DFT facet pass: each device's einsum covers
     its local facets only (no collectives; the facet sum happens later in
     the column pass psum)."""
-    n_arrays = 2 if _planar(core) else 1  # planes vs complex facets
+    if real_facets:
+        n_arrays = 1  # single real plane
+    else:
+        n_arrays = 2 if _planar(core) else 1  # planes vs complex facets
     in_specs = tuple([_P(FACET_AXIS)] * n_arrays) + (_P(FACET_AXIS), _P())
     return _shmap(
-        _facet_pass_sampled_fn(core), mesh,
+        _facet_pass_sampled_fn(core, real_facets), mesh,
         in_specs=in_specs,
         out_specs=_P(FACET_AXIS),
     )
+
+
+# -- sampled-DFT backward facet pass (the exact adjoint) --------------------
+#
+# The backward facet pass along axis 0 is, per facet f and output row i:
+#
+#   out[f, i] = fb[i] * wrapped_extract(fft(sum_k wrapped_embed(
+#                   roll(rows_k[f], -s_k), yN, s_k)), yB, delta_f)[i]
+#
+# Tracing one element rows_k[f, r] through embed+roll shows it lands at
+# spectral position q_k(r) = (kt_r + yN//2) mod yN — the SAME kt indices
+# the forward extracts (sampled_row_indices). The centred fft then gives
+#
+#   out[f, i] = fb[i] * sum_k sum_r rows_k[f, r] * w^{-kt_r (e0_f + i)}
+#
+# (w = e^{+2pi i/yN}, e0_f = facet_off0 - yB//2, NO 1/yN — fft is
+# unnormalised where the forward's ifft carried the 1/yN). So the whole
+# backward facet pass is the conjugate-phase transpose of the forward's
+# sampled matmul: one einsum per column (group) accumulating directly
+# into the [F, yB, yB] image-space facet accumulator — which is the SIZE
+# OF THE OUTPUT, the minimal possible device state. No NAF_all buffer,
+# no host round trip, no d2h until the final (verified-on-device) facets.
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_sampled_fold_fn(core):
+    """acc [F, yB, yB(,2)] += adjoint-sampled fold of rows [F, R, yB(,2)].
+
+    `rows` are a column group's NAF_BMNAF rows concatenated along R (the
+    output of the backward column pass, already finished+masked along
+    axis 1); `krows` their centred spectral indices; `e0` the per-facet
+    embedding shifts. Validated against the FFT-based `_facet_pass_bwd`
+    by tests/test_streamed.py.
+    """
+    import jax.numpy as jnp
+
+    yN = core.yN_size
+
+    def phases(residues):
+        theta = (2 * np.pi / yN) * residues
+        return jnp.cos(theta), jnp.sin(theta)
+
+    if _planar(core):
+
+        def fn(acc, rows, e0, krows):
+            yB = acc.shape[1]
+            dt = acc.dtype
+            fb = core._p.extract_mid(core._Fb, yB, 0)  # [yB] real, no 1/yN
+            # conjugate per-facet phase: rows * w^{-e0_f kt_r}
+            p_cos, p_sin = phases(
+                _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
+            )  # [F, R]
+            p_cos = p_cos.astype(dt)[..., None]
+            p_sin = p_sin.astype(dt)[..., None]
+            Rr, Ri = rows[..., 0], rows[..., 1]
+            Rr2 = Rr * p_cos + Ri * p_sin
+            Ri2 = Ri * p_cos - Rr * p_sin
+            i = jnp.arange(yB, dtype=jnp.int32)
+            b_cos, b_sin = phases(_mulmod(krows[:, None], i[None, :], yN))
+            Bc = b_cos.astype(dt)
+            Bs = b_sin.astype(dt)
+            from ..ops.planar_backend import _PRECISION
+
+            f = lambda a, b: jnp.einsum(
+                "ri,frj->fij", a, b, precision=_PRECISION
+            )
+            out_re = f(Bc, Rr2) + f(Bs, Ri2)
+            out_im = f(Bc, Ri2) - f(Bs, Rr2)
+            out = jnp.stack([out_re, out_im], axis=-1)
+            return acc + out * fb[None, :, None, None]
+
+    else:
+
+        def fn(acc, rows, e0, krows):
+            yB = acc.shape[1]
+            fb = core._p.extract_mid(core._Fb, yB, 0)
+            p_cos, p_sin = phases(
+                _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
+            )
+            phi = (p_cos - 1j * p_sin).astype(core.dtype)  # [F, R]
+            i = jnp.arange(yB, dtype=jnp.int32)
+            b_cos, b_sin = phases(_mulmod(krows[:, None], i[None, :], yN))
+            B = (b_cos - 1j * b_sin).astype(core.dtype)  # [R, yB_i]
+            out = jnp.einsum("ri,frj->fij", B, rows * phi[..., None])
+            return acc + out * fb[None, :, None]
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_sampled_fold_j(core):
+    return _jit(donate=(0,))(_bwd_sampled_fold_fn(core))
+
+
+@functools.lru_cache(maxsize=None)
+def _sampled_finish_j(core):
+    """Apply the axis-0 facet masks to the sampled accumulator (the Fb
+    weighting and spectral extraction already happened in the fold)."""
+
+    def fn(acc, masks0):
+        m = masks0[:, :, None]
+        if _planar(core):
+            m = m[..., None]
+        return acc * m
+
+    return _jit()(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_sampled_fold_sharded(core, mesh):
+    """Facet-sharded fold: each device updates its local facets' image
+    accumulator (no collectives — rows and acc share the facet axis)."""
+    return _shmap(
+        _bwd_sampled_fold_fn(core), mesh,
+        in_specs=(_P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P()),
+        out_specs=_P(FACET_AXIS),
+        donate=(0,),
+    )
+
+
+# -- facet-group forward column step ----------------------------------------
+#
+# At N >= 65536 the facet stack exceeds HBM (36.5 GB planar at 64k), so
+# the sampled-DFT path streams FACET GROUPS: columns are processed in
+# groups of G, and within a column group the facets arrive in slabs of
+# `facet_group`; each slab's finished contribution is ADDED into a
+# per-column-group output accumulator (every stage of the transform —
+# including the finish iFFT, crop and masks — is linear in the facets,
+# so accumulating finished subgrids across facet slabs is exact). The
+# repeated finish costs ~1% extra FLOPs and buys a [G,S,xA,xA] instead
+# of a [G,S,xM,xM] accumulator. Device residency: one facet slab + the
+# accumulator + one sampled group buffer — bounded regardless of N.
+
+
+def _column_group_step_fn(core, subgrid_size, chunk):
+    """One facet slab's finished contribution, added into the group acc.
+
+    acc [n_chunks, chunk, S, xA, xA(,2)]; buf [Fg, G*m, yB(,2)] is the
+    slab's sampled rows for the whole column group (G = n_chunks*chunk).
+    Columns are scanned `chunk` at a time to bound the [chunk, S, xM, xM]
+    transient while keeping a chunk*S batch for the small-matmul finish
+    stages.
+    """
+    m = core.xM_yN_size
+    colfn = _column_pass_fwd_fn(core, subgrid_size)
+
+    def fn(acc, buf, foffs0, foffs1, sg_offs_g, masks0_g, masks1_g):
+        Fg = buf.shape[0]
+        n_chunks = acc.shape[0]
+        G = n_chunks * acc.shape[1]
+        NMBF_g = jax.numpy.moveaxis(
+            buf.reshape((Fg, G, m) + buf.shape[2:]), 1, 0
+        )  # [G, Fg, m, yB(,2)]
+        NMBF_c = NMBF_g.reshape((n_chunks, acc.shape[1]) + NMBF_g.shape[1:])
+
+        def step(carry, xs):
+            c, nm, so, m0, m1 = xs
+            out = jax.vmap(colfn, in_axes=(0, None, None, 0, 0, 0))(
+                nm, foffs0, foffs1, so, m0, m1
+            )  # [chunk, S, xA, xA(,2)]
+            return carry.at[c].add(out), None
+
+        idx = jax.numpy.arange(n_chunks)
+        acc, _ = jax.lax.scan(
+            step, acc, (idx, NMBF_c, sg_offs_g, masks0_g, masks1_g)
+        )
+        return acc
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _column_group_step_j(core, subgrid_size, chunk):
+    return _jit(donate=(0,))(_column_group_step_fn(core, subgrid_size, chunk))
 
 
 
@@ -529,8 +769,10 @@ class _StreamedBase:
                 "Streamed execution requires a device backend "
                 "('jax' or 'planar')"
             )
-        if residency not in ("host", "device"):
-            raise ValueError(f"residency must be host|device, got {residency}")
+        if residency not in ("host", "device", "sampled"):
+            raise ValueError(
+                f"residency must be host|device|sampled, got {residency}"
+            )
         self.residency = residency
         self.stack = _FacetStack(
             facet_configs, pad_to=_mesh_size(self.mesh)
@@ -612,7 +854,12 @@ class StreamedForward:
     """
 
     def __init__(self, swiftly_config, facet_tasks, col_block=512,
-                 residency="host", col_group=None):
+                 residency="host", col_group=None, facet_group=None):
+        if residency == "sampled":
+            raise ValueError(
+                "residency='sampled' is a StreamedBackward strategy; the "
+                "forward equivalent is residency='device' (sampled DFT)"
+            )
         self._base = _StreamedBase(
             swiftly_config, [cfg for cfg, _ in facet_tasks], col_block,
             residency,
@@ -621,13 +868,45 @@ class StreamedForward:
         self.stack = self._base.stack
         # Facet data held host-side in device layout, one array per facet
         # (never stacked: the stack is larger than any single block).
-        self._facet_data = [
-            _to_host_layout(core, d) for _, d in facet_tasks
-        ]
+        # All-real facets (planar) are stored as single real planes —
+        # half the host RAM and half the upload volume; the sampled path
+        # then also skips the zero imaginary plane's einsums. A task's
+        # data may be a CALLABLE returning the facet (lazy construction:
+        # at 64k one complex128 facet is 8 GB — materialising all of them
+        # before conversion would double the host footprint).
+        store, real_flags = [], []
+        for _, d in facet_tasks:
+            raw = d() if callable(d) else d
+            plane = _real_plane_or_none(core, raw)
+            if plane is not None:
+                store.append(plane)
+                real_flags.append(True)
+            else:
+                store.append(_to_host_layout(core, raw))
+                real_flags.append(False)
+            del raw
+        self._facets_real = all(real_flags)
+        if not self._facets_real and any(real_flags):
+            # mixed: re-expand the real planes to planar pairs
+            for i, (s, is_real) in enumerate(zip(store, real_flags)):
+                if is_real:
+                    pair = np.zeros(s.shape + (2,), dtype=s.dtype)
+                    pair[..., 0] = s
+                    store[i] = pair
+        self._facet_data = store
         self.col_group = col_group
+        # facet_group: max facets device-resident at once (sampled path).
+        # None = auto (all resident if the stack fits the HBM budget,
+        # else slabs of 1 streamed per column group).
+        self.facet_group = facet_group
         self._dev_facets = None
         self._nmbf = None
         self._col_index = None
+        self.last_plan = None  # set by the sampled-path generators
+        # extra device bytes the CALLER keeps resident during streaming
+        # (e.g. an uploaded oracle-sample stack); subtracted from the HBM
+        # budget the auto-sizers see
+        self.hbm_headroom = 0
 
     # -- facet pass --------------------------------------------------------
 
@@ -640,7 +919,10 @@ class StreamedForward:
         block = np.zeros(shape, dtype=_np_dtype(core))
         j1 = min(j0 + Cb, yB)
         for i, data in enumerate(self._facet_data):
-            block[i, :, : j1 - j0] = data[:, j0:j1]
+            if self._facets_real and _planar(core):
+                block[i, :, : j1 - j0, 0] = data[:, j0:j1]
+            else:
+                block[i, :, : j1 - j0] = data[:, j0:j1]
         return block
 
     def _build_nmbf(self, col_offs0):
@@ -709,7 +991,13 @@ class StreamedForward:
         groups = _group_full_columns(subgrid_configs)
         size = subgrid_configs[0].size
         if self._base.residency == "device":
-            gen = self._device_columns(groups, size)
+            fg = self.facet_group
+            if fg is None and not self._facet_stack_fits():
+                fg = 1
+            if fg is not None and fg < self._base.stack.n_total:
+                gen = self._grouped_device_columns(groups, size, fg)
+            else:
+                gen = self._device_columns(groups, size)
         else:
             if self._base.mesh is not None:
                 colfn = _column_pass_fwd_sharded(
@@ -761,7 +1049,15 @@ class StreamedForward:
         yB = base.stack.size
         n_pad = base.stack.n_total - base.stack.n_real
         if self._dev_facets is None:
-            if _planar(core):
+            if self._facets_real:
+                host = np.ascontiguousarray(
+                    np.stack(
+                        self._facet_data
+                        + [np.zeros_like(self._facet_data[0])] * n_pad
+                    )
+                )
+                self._dev_facets = (base._place(host),)
+            elif _planar(core):
                 # upload re/im planes as separate contiguous arrays (the
                 # sampled program must not slice them out of a stacked
                 # array — that would copy the multi-GiB stack)
@@ -791,13 +1087,16 @@ class StreamedForward:
         )
         col_offs0 = list(groups)
         G = self.col_group or self._auto_col_group(len(col_offs0))
+        self.last_plan = {"mode": "resident", "col_group": G}
         if base.mesh is not None:
-            samfn = _facet_pass_sampled_sharded(core, base.mesh)
+            samfn = _facet_pass_sampled_sharded(
+                core, base.mesh, self._facets_real
+            )
             gcolfn = _column_pass_fwd_group_sharded(
                 core, base.mesh, subgrid_size
             )
         else:
-            samfn = _facet_pass_sampled_j(core)
+            samfn = _facet_pass_sampled_j(core, self._facets_real)
             gcolfn = _column_pass_fwd_group_j(core, subgrid_size)
         from ..api import _subgrid_masks
 
@@ -842,12 +1141,177 @@ class StreamedForward:
                 items = [it for it in prog_items if it[0] is not None]
                 yield items, out_g[gi]
 
-    def _auto_col_group(self, n_cols):
-        """Largest column-group whose buffer + transients fit the budget.
+    def _grouped_device_columns(self, groups, subgrid_size, facet_group):
+        """Sampled-DFT pass streaming FACET SLABS: stacks larger than HBM.
 
-        HBM budget: SWIFTLY_HBM_BUDGET (bytes) if set, else 90% of the
-        device's reported capacity (`memory_stats()["bytes_limit"]`),
-        else 14e9. On CPU the full column set is one group.
+        Column groups of G are the outer loop; within one, facet slabs of
+        `facet_group` upload in turn and each slab's FINISHED contribution
+        is added into the group's [G, S, xA, xA] accumulator (exact —
+        every stage incl. the finish iFFT/crop/masks is linear in the
+        facets). Device residency is one slab + the accumulator + one
+        sampled buffer, bounded regardless of N; the cost is re-uploading
+        the facet stack once per column group (h2d, overlapped with
+        compute by the depth-2 dispatch pipeline below).
+        """
+        import collections
+
+        import jax.numpy as jnp
+
+        from ..api import _subgrid_masks
+
+        base = self._base
+        core = base.core
+        if base.mesh is not None:
+            raise ValueError(
+                "facet_group streaming is a single-device strategy; on a "
+                "mesh the facet stack is already sharded across devices — "
+                "add devices instead of slabs"
+            )
+        yB = base.stack.size
+        F_total = base.stack.n_total
+        Fg = int(facet_group)
+        n_slabs = -(-F_total // Fg)
+        F_pad = n_slabs * Fg
+        rdt = core._Fb.dtype
+
+        col_offs0 = list(groups)
+        first_col = next(iter(groups.values()))
+        S = len(first_col)
+        chunk = 4
+        if self.col_group:
+            # honour an explicit G exactly: pick the largest chunk that
+            # divides it rather than silently rounding G down
+            G = max(1, int(self.col_group))
+            chunk = next(c for c in (4, 3, 2, 1) if G % c == 0)
+        else:
+            budget = self._hbm_budget()
+            if budget is None:
+                G = len(col_offs0)
+                chunk = next(c for c in (4, 3, 2, 1) if G % c == 0)
+            else:
+                G = grouped_col_group_for_budget(
+                    base, budget, len(col_offs0), S, subgrid_size,
+                    self._facets_real, Fg, chunk,
+                )
+        chunk = min(chunk, G)
+        G = (G // chunk) * chunk
+        n_chunks = G // chunk
+        self.last_plan = {
+            "mode": "grouped", "col_group": G, "facet_group": Fg,
+            "n_slabs": n_slabs,
+        }
+
+        # per-slab facet metadata, padded with zero facets to F_pad
+        offs0 = np.concatenate(
+            [np.asarray(base.stack.offs0), np.zeros(F_pad - F_total, int)]
+        )
+        offs1 = np.concatenate(
+            [np.asarray(base.stack.offs1), np.zeros(F_pad - F_total, int)]
+        )
+        e0 = (offs0 - yB // 2).astype(np.int32)
+
+        def host_slab(s0):
+            idx = range(s0, s0 + Fg)
+            if self._facets_real:
+                zero = np.zeros((yB, yB), dtype=_np_dtype(core))
+                return (
+                    np.stack(
+                        [
+                            self._facet_data[i]
+                            if i < base.stack.n_real
+                            else zero
+                            for i in idx
+                        ]
+                    ),
+                )
+            if _planar(core):
+                zero = np.zeros((yB, yB), dtype=_np_dtype(core))
+                return tuple(
+                    np.ascontiguousarray(
+                        np.stack(
+                            [
+                                self._facet_data[i][..., p]
+                                if i < base.stack.n_real
+                                else zero
+                                for i in idx
+                            ]
+                        )
+                    )
+                    for p in (0, 1)
+                )
+            zero = np.zeros((yB, yB), dtype=_np_dtype(core))
+            return (
+                np.stack(
+                    [
+                        np.asarray(self._facet_data[i])
+                        if i < base.stack.n_real
+                        else zero
+                        for i in idx
+                    ]
+                ),
+            )
+
+        samfn = _facet_pass_sampled_j(core, self._facets_real)
+        stepfn = _column_group_step_j(core, subgrid_size, chunk)
+        tail = _tail(core)
+        xA = subgrid_size
+        # depth-2 completion pipeline: before uploading slab i, wait for
+        # slab i-2's column step (8-byte checksum pull — block_until_ready
+        # is not completion on tunnel runtimes), bounding live slabs to 2.
+        pending = collections.deque()
+        for g0 in range(0, len(col_offs0), G):
+            grp = col_offs0[g0 : g0 + G]
+            grp_padded = grp + [grp[-1]] * (G - len(grp))
+            krows = jnp.asarray(sampled_row_indices(core, grp_padded))
+            sg_offs_g, m0_g, m1_g = [], [], []
+            for off0 in grp_padded:
+                prog_items = groups[off0]  # incl. zero-mask padding
+                sg_offs_g.append(
+                    [(sg.off0, sg.off1) for _, sg in prog_items]
+                )
+                ms = [_subgrid_masks(sg) for _, sg in prog_items]
+                m0_g.append([mk[0] for mk in ms])
+                m1_g.append([mk[1] for mk in ms])
+
+            def _chunked(x, dt=None):
+                a = jnp.asarray(np.asarray(x), dt)
+                return a.reshape((n_chunks, chunk) + a.shape[1:])
+
+            so_c = _chunked(sg_offs_g)
+            m0_c = _chunked(m0_g, rdt)
+            m1_c = _chunked(m1_g, rdt)
+            acc = jnp.zeros(
+                (n_chunks, chunk, S, xA, xA) + tail, dtype=_np_dtype(core)
+            )
+            for s0 in range(0, F_pad, Fg):
+                while len(pending) >= 2:
+                    np.asarray(pending.popleft())
+                slab_dev = tuple(base._place(a) for a in host_slab(s0))
+                buf = samfn(
+                    *slab_dev,
+                    jnp.asarray(e0[s0 : s0 + Fg]),
+                    krows,
+                )
+                acc = stepfn(
+                    acc,
+                    buf,
+                    jnp.asarray(offs0[s0 : s0 + Fg]),
+                    jnp.asarray(offs1[s0 : s0 + Fg]),
+                    so_c,
+                    m0_c,
+                    m1_c,
+                )
+                pending.append(jnp.sum(acc))
+            for gi, off0 in enumerate(grp):
+                prog_items = groups[off0]
+                items = [it for it in prog_items if it[0] is not None]
+                yield items, acc[gi // chunk, gi % chunk]
+
+    def _hbm_budget(self):
+        """Per-device HBM budget in bytes (None = unlimited, e.g. CPU).
+
+        SWIFTLY_HBM_BUDGET (bytes) if set, else 90% of the device's
+        reported capacity (`memory_stats()["bytes_limit"]`), else 14e9.
         """
         import os
 
@@ -855,17 +1319,36 @@ class StreamedForward:
 
         device = jax.devices()[0]
         if device.platform == "cpu":
-            return n_cols
+            return None
         env = os.environ.get("SWIFTLY_HBM_BUDGET")
         if env:
-            budget = float(env)
-        else:
-            try:
-                limit = (device.memory_stats() or {}).get("bytes_limit", 0)
-            except Exception:  # pragma: no cover - backend-specific
-                limit = 0
-            budget = 0.9 * limit if limit else 14e9
-        return col_group_for_budget(self._base, budget, n_cols)
+            return float(env) - self.hbm_headroom
+        try:
+            limit = (device.memory_stats() or {}).get("bytes_limit", 0)
+        except Exception:  # pragma: no cover - backend-specific
+            limit = 0
+        return (0.9 * limit if limit else 14e9) - self.hbm_headroom
+
+    def _facet_stack_fits(self):
+        """Whether the whole facet stack can stay device-resident with
+        room for at least a one-column working set."""
+        budget = self._hbm_budget()
+        if budget is None:
+            return True
+        return (
+            facet_stack_bytes(self._base, self._facets_real) + 3e9 <= budget
+        )
+
+    def _auto_col_group(self, n_cols):
+        """Largest column-group whose buffer + transients fit the budget
+        (facets-resident sampled path). On CPU the full column set is one
+        group."""
+        budget = self._hbm_budget()
+        if budget is None:
+            return n_cols
+        return col_group_for_budget(
+            self._base, budget, n_cols, real=self._facets_real
+        )
 
     def all_subgrids(self, subgrid_configs):
         """Every subgrid, in request order, as one host array [n, xA, xA]."""
@@ -881,7 +1364,48 @@ class StreamedForward:
         return out
 
 
-def col_group_for_budget(base, budget, n_cols):
+def facet_stack_bytes(base, real=False):
+    """Device bytes of the (padded) resident facet stack."""
+    core = base.core
+    itemsize = np.dtype(core.dtype).itemsize
+    per_el = itemsize if real else itemsize * (2 if _planar(core) else 1)
+    yB = base.stack.size
+    F = base.stack.n_total // _mesh_size(base.mesh)
+    return F * yB * yB * per_el
+
+
+def grouped_col_group_for_budget(
+    base, budget, n_cols, S, subgrid_size, real, facet_group, chunk
+):
+    """Largest column-group G for the facet-slab-streamed sampled path.
+
+    Live per unit G: the slab's sampled buffer [Fg, m, yB] plus its
+    in-step [G, Fg, m, yB] transpose, and the finished accumulator row
+    [S, xA, xA]. Flat: two facet slabs in flight (depth-2 pipeline), the
+    per-chunk scan transients ([chunk, S, xM, xM] carry + prep1 rows),
+    and a trig/fragmentation reserve.
+    """
+    core = base.core
+    dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
+    fsize = np.dtype(core.dtype).itemsize * (1 if real else 2)
+    yB = base.stack.size
+    m = core.xM_yN_size
+    xM = core.xM_size
+    xA = subgrid_size
+    slab_b = 2 * facet_group * yB * yB * fsize
+    chunk_b = (
+        chunk * S * xM * xM + chunk * facet_group * m * core.yN_size
+    ) * dsize
+    per_G = (
+        2 * facet_group * m * yB + S * xA * xA
+    ) * dsize
+    reserve = 0.6e9
+    G = int((budget - slab_b - chunk_b - reserve) // per_G)
+    G = max(chunk, (G // chunk) * chunk)
+    return min(G, ((n_cols + chunk - 1) // chunk) * chunk)
+
+
+def col_group_for_budget(base, budget, n_cols, real=False):
     """Largest sampled-DFT column-group G whose working set fits `budget`
     bytes on one device (facet stack + per-G transients).
 
@@ -901,8 +1425,8 @@ def col_group_for_budget(base, budget, n_cols):
     core = base.core
     dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
     yB = base.stack.size
+    facets_b = facet_stack_bytes(base, real)
     F = len(base.stack) // _mesh_size(base.mesh)
-    facets_b = F * yB * yB * dsize
     reserve = 0.4e9  # calibrated: yields G=4 at the v5e 14e9 default
     m = core.xM_yN_size
     xA = base.config.max_subgrid_size
@@ -927,66 +1451,165 @@ class StreamedBackward:
     Subgrids are fed column-grouped in any order; repeated columns
     accumulate (every fold is linear). `finish()` streams the column
     buffer back through the device to emit the facet stack.
+
+    :param residency: "host" buffers per-column NAF rows in host RAM;
+        "device" keeps them as device arrays (both sized K*[F, m, yB]);
+        "sampled" folds each column's rows STRAIGHT into a device
+        [F, yB, yB] image-space facet accumulator via the adjoint
+        sampled-DFT einsum (see `_bwd_sampled_fold_fn`) — device state
+        equals the OUTPUT size, the strategy for 32k+ scale where the
+        per-column row set (K*F*m*yB ~ 30 GB at 32k) fits neither HBM
+        nor the d2h budget of a tunnel-attached chip.
+    :param fold_group: ("sampled") columns folded per einsum dispatch —
+        batches the adjoint contraction depth to fold_group*m rows.
     """
 
     def __init__(self, swiftly_config, facet_configs, col_block=512,
-                 residency="host"):
+                 residency="host", fold_group=4):
         self._base = _StreamedBase(
             swiftly_config, facet_configs, col_block, residency
         )
         self.core = self._base.core
         self.stack = self._base.stack
         self._naf = {}  # off0 -> host/device [F, m, yB_pad(,2)] rows
+        self._acc = None  # ("sampled") device [F, yB, yB(,2)] accumulator
+        self._fold_group = max(1, int(fold_group))
+        self._pending_rows = []  # ("sampled") [(off0, rows [F, m, yB(,2)])]
         self._finished = False
 
     def add_subgrids(self, tasks):
         """Fold (SubgridConfig, subgrid_data) pairs into the accumulators."""
+        if self._finished:
+            raise RuntimeError("finish() was already called")
+        groups = {}
+        for sg, data in tasks:
+            groups.setdefault(sg.off0, []).append((sg, data))
+        for group in groups.values():
+            self.add_subgrid_stack([sg for sg, _ in group],
+                                   [d for _, d in group])
+
+    def add_subgrid_stack(self, sg_configs, subgrids):
+        """Fold one column's subgrids, given as a stack.
+
+        :param sg_configs: the column's SubgridConfigs (one shared off0)
+        :param subgrids: matching [S, xA, xA(,2)] — a DEVICE array (e.g.
+            straight from `StreamedForward.stream_columns(...,
+            device_arrays=True)`, no host round trip), or any host
+            array/list of per-subgrid arrays.
+        """
         import jax.numpy as jnp
 
         if self._finished:
             raise RuntimeError("finish() was already called")
         base = self._base
         core = base.core
-        groups = {}
-        for sg, data in tasks:
-            groups.setdefault(sg.off0, []).append((sg, data))
-        yB = base.stack.size
-        for off0, group in groups.items():
-            subgrids = jnp.stack(
-                [jnp.asarray(_to_host_layout(core, d)) for _, d in group]
+        off0s = {sg.off0 for sg in sg_configs}
+        if len(off0s) != 1:
+            raise ValueError(
+                f"add_subgrid_stack takes ONE column, got offsets {off0s}"
             )
-            sg_offs = jnp.asarray([(sg.off0, sg.off1) for sg, _ in group])
+        off0 = off0s.pop()
+        yB = base.stack.size
+        if hasattr(subgrids, "sharding"):  # already a placed jax array
+            subgrids = jnp.asarray(subgrids)
+        else:
+            subgrids = jnp.stack(
+                [jnp.asarray(_to_host_layout(core, d)) for d in subgrids]
+            )
+        sg_offs = jnp.asarray([(sg.off0, sg.off1) for sg in sg_configs])
+        if base.mesh is not None:
+            colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
+        else:
+            colfn = _column_pass_bwd_j(core, yB)
+        rows = colfn(
+            subgrids,
+            sg_offs,
+            base._foffs0,
+            base._foffs1,
+            base._masks1_dev,
+        )  # [F, m, yB] (facet-sharded on a mesh)
+        key = int(off0)
+        if base.residency == "sampled":
+            self._pending_rows.append((key, rows))
+            if len(self._pending_rows) >= self._fold_group:
+                self._flush_folds()
+            return
+        pad = base._yB_pad - yB
+        if pad:
+            widths = [(0, 0), (0, 0), (0, pad)] + [
+                (0, 0) for _ in _tail(core)
+            ]
+            rows = jnp.pad(rows, widths)
+        if base.residency == "device":
+            prev = self._naf.get(key)
+            self._naf[key] = rows if prev is None else prev + rows
+        else:
+            if key in self._naf:
+                self._naf[key] += np.asarray(rows)
+            else:
+                self._naf[key] = np.array(rows)  # writable copy
+
+    def _flush_folds(self):
+        """("sampled") fold the pending columns' rows into the image-space
+        accumulator: one adjoint-sampled einsum over fold_group*m rows."""
+        import jax.numpy as jnp
+
+        if not self._pending_rows:
+            return
+        base = self._base
+        core = base.core
+        yB = base.stack.size
+        if self._acc is None:
+            shape = (base.stack.n_total, yB, yB) + _tail(core)
             if base.mesh is not None:
-                colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
+                self._acc = base._place(
+                    np.zeros(shape, dtype=_np_dtype(core))
+                )
             else:
-                colfn = _column_pass_bwd_j(core, yB)
-            rows = colfn(
-                subgrids,
-                sg_offs,
-                base._foffs0,
-                base._foffs1,
-                base._masks1_dev,
-            )  # [F, m, yB] (facet-sharded on a mesh)
-            pad = base._yB_pad - yB
-            if pad:
-                widths = [(0, 0), (0, 0), (0, pad)] + [
-                    (0, 0) for _ in _tail(core)
-                ]
-                rows = jnp.pad(rows, widths)
-            key = int(off0)
-            if base.residency == "device":
-                prev = self._naf.get(key)
-                self._naf[key] = rows if prev is None else prev + rows
-            else:
-                if key in self._naf:
-                    self._naf[key] += np.asarray(rows)
-                else:
-                    self._naf[key] = np.array(rows)  # writable copy
+                self._acc = jnp.zeros(shape, dtype=_np_dtype(core))
+        e0 = getattr(self, "_e0_dev", None)
+        if e0 is None:
+            e0 = self._e0_dev = base._place(
+                (np.asarray(base.stack.offs0) - yB // 2).astype(np.int32)
+            )
+        offs = [o for o, _ in self._pending_rows]
+        krows = jnp.asarray(sampled_row_indices(core, offs))
+        rows_cat = (
+            self._pending_rows[0][1]
+            if len(self._pending_rows) == 1
+            else jnp.concatenate(
+                [r for _, r in self._pending_rows], axis=1
+            )
+        )  # [F, P*m, yB(,2)]
+        if base.mesh is not None:
+            foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
+        else:
+            foldfn = _bwd_sampled_fold_j(core)
+        self._acc = foldfn(self._acc, rows_cat, e0, krows)
+        self._pending_rows = []
+
+    def finish_device(self):
+        """("sampled") the finished facet stack [F_total, yB, yB(,2)] as a
+        DEVICE array — callers at 32k+ scale verify/consume it on device
+        (a full host pull is d2h-bound on tunnel-attached chips)."""
+        if self._base.residency != "sampled":
+            raise ValueError("finish_device() requires residency='sampled'")
+        if self._finished:
+            raise RuntimeError("finish() was already called")
+        self._flush_folds()
+        if self._acc is None:
+            raise RuntimeError("No subgrids were added")
+        fn = _sampled_finish_j(self.core)
+        out = fn(self._acc, self._base._masks0_dev)
+        self._finished = True
+        return out
 
     def finish(self):
         """Emit the finished facet stack [F, yB, yB(,2)] (host array)."""
         import jax.numpy as jnp
 
+        if self._base.residency == "sampled":
+            return np.asarray(self.finish_device())[: self.stack.n_real]
         if self._finished:
             raise RuntimeError("finish() was already called")
         base = self._base

@@ -141,6 +141,13 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
         "naf_keys": [],
         "processed": list(map(list, processed_subgrids or [])),
     }
+    if backward._base.residency == "sampled":
+        # the whole state is the image-space accumulator (pending rows
+        # fold first so the snapshot is self-contained)
+        backward._flush_folds()
+        meta["has_acc"] = backward._acc is not None
+        if backward._acc is not None:
+            arrays["acc"] = np.asarray(backward._acc)
     for key, rows in backward._naf.items():
         meta["naf_keys"].append(int(key))
         arrays[f"naf_{int(key)}"] = np.asarray(rows)
@@ -161,6 +168,18 @@ def restore_streamed_backward_state(path, backward):
         meta = json.loads(bytes(data["meta"].tobytes()).decode())
         core = backward.core
         _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
+        saved_res = meta.get("residency")
+        is_sampled = backward._base.residency == "sampled"
+        if (saved_res == "sampled") != is_sampled:
+            raise ValueError(
+                f"Checkpoint holds residency={saved_res!r} state; this "
+                f"session uses {backward._base.residency!r} (the sampled "
+                f"accumulator and NAF rows are not interchangeable)"
+            )
+        if is_sampled:
+            if meta.get("has_acc"):
+                backward._acc = backward._base._place(data["acc"])
+            return [tuple(p) for p in meta["processed"]]
         # older snapshots (same _VERSION) did not record yB_pad; the rows
         # arrays carry it as their last data axis either way
         saved_pad = meta.get("yB_pad")

@@ -24,6 +24,7 @@ __all__ = [
     "forward_batched_flops",
     "forward_sampled_flops",
     "backward_batched_flops",
+    "backward_sampled_flops",
     "peak_tflops",
 ]
 
@@ -91,24 +92,68 @@ def forward_batched_flops(
 def forward_sampled_flops(
     core, n_facets: int, facet_size: int, n_columns: int,
     subgrids_per_column: int, subgrid_size: int,
+    real_facets: bool = False, finish_passes: int = 1,
 ) -> int:
     """Total FLOPs of the streamed device-resident (sampled-DFT) forward.
 
     Facet pass: one [R, yB] x [F*yB, yB] complex matmul with R = C*m
     sampled rows, plus the per-facet diagonal phase; column pass: same as
     the batched path's per-column work.
+
+    ``real_facets``: the facets' imaginary plane is identically zero, so
+    the sampled matmul is 2 real matmuls instead of 4 — HALF the facet
+    pass FLOPs (honest accounting: work skipped is not work done).
+    ``finish_passes``: the facet-slab-streamed path finishes each subgrid
+    once per slab and sums (linearity) — count the repeats.
     """
     yB = facet_size
-    m = core.xM_yN_size
+    m, xM = core.xM_yN_size, core.xM_size
     R = n_columns * m
-    facet_pass = 8 * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
+    mm = 4 if real_facets else 8
+    facet_pass = mm * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
     columns = n_columns * _column_prepare_flops(core, n_facets)
     subgrids = (
         n_columns
         * subgrids_per_column
         * _per_subgrid_flops(core, subgrid_size, n_facets)
     )
-    return facet_pass + columns + subgrids
+    extra_finish = (
+        (finish_passes - 1)
+        * n_columns
+        * subgrids_per_column
+        * (fft_flops(xM, xM) + fft_flops(xM, subgrid_size)
+           + 4 * subgrid_size**2)
+    )
+    return facet_pass + columns + subgrids + extra_finish
+
+
+def backward_sampled_flops(
+    core, n_facets: int, facet_size: int, n_columns: int,
+    subgrids_per_column: int, subgrid_size: int,
+) -> int:
+    """Total FLOPs of the streamed sampled-residency backward transform.
+
+    Column stage per subgrid (prepare + per-facet extract) and per-column
+    axis-1 finish as in the batched path; the axis-0 facet pass is the
+    adjoint sampled einsum: [R, yB_i]^T x [F, R, yB_j] over all R =
+    n_columns*m rows, plus conjugate phases and the Fb weighting.
+    """
+    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+    yB = facet_size
+    prep = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
+    extract = n_facets * (
+        fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+    )
+    col_fin = n_facets * (fft_flops(yN, m) + 6 * m * yB)
+    R = n_columns * m
+    fold = 8 * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
+    finish_mask = 2 * n_facets * yB * yB
+    return (
+        n_columns * subgrids_per_column * (prep + extract)
+        + n_columns * col_fin
+        + fold
+        + finish_mask
+    )
 
 
 def backward_batched_flops(

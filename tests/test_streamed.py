@@ -255,3 +255,207 @@ def test_col_group_budget_accounting():
     gs = [col_group_for_budget(fwd._base, b, 10**6)
           for b in (1e9, 4e9, 16e9, 64e9)]
     assert gs == sorted(gs)
+
+
+# ---------------------------------------------------------------------------
+# Real-facet fast path, facet-slab streaming, sampled backward
+# ---------------------------------------------------------------------------
+
+
+def test_real_facet_path_detected_and_matches():
+    """Point-source facets are exactly real: the planar streamed forward
+    stores single real planes (half the upload) and matches batched."""
+    from swiftly_tpu import SwiftlyForward
+
+    config, _, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    assert fwd._facets_real
+    assert fwd._facet_data[0].ndim == 2  # single plane, not (re, im) pairs
+    ref = np.asarray(
+        SwiftlyForward(config, facet_tasks, 3, 64).all_subgrids(
+            subgrid_configs
+        )
+    )
+    np.testing.assert_allclose(
+        fwd.all_subgrids(subgrid_configs), ref, atol=1e-10
+    )
+
+
+def test_complex_facet_fallback_matches():
+    """Facets with imaginary content fall back to the planar-pair path."""
+    from swiftly_tpu import SwiftlyForward
+
+    config, _, subgrid_configs, facet_tasks = _setup("planar")
+    rng = np.random.default_rng(3)
+    facet_tasks = [
+        (fc, d + 1j * rng.normal(scale=0.1, size=d.shape))
+        for fc, d in facet_tasks
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    assert not fwd._facets_real
+    ref = np.asarray(
+        SwiftlyForward(config, facet_tasks, 3, 64).all_subgrids(
+            subgrid_configs
+        )
+    )
+    np.testing.assert_allclose(
+        fwd.all_subgrids(subgrid_configs), ref, atol=1e-10
+    )
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize("facet_group", [1, 2])
+def test_facet_slab_streaming_matches(backend, facet_group):
+    """Facet-slab-streamed column groups == facets-resident sampled path
+    (slab padding and cross-slab finished accumulation are exact)."""
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    ref = StreamedForward(
+        config, facet_tasks, residency="device"
+    ).all_subgrids(subgrid_configs)
+    out = StreamedForward(
+        config, facet_tasks, residency="device",
+        facet_group=facet_group, col_group=4,
+    ).all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_facet_slab_streaming_auto_group():
+    """facet_group with auto column-group sizing (CPU: one group)."""
+    config, _, subgrid_configs, facet_tasks = _setup("planar")
+    ref = StreamedForward(
+        config, facet_tasks, residency="device"
+    ).all_subgrids(subgrid_configs)
+    out = StreamedForward(
+        config, facet_tasks, residency="device", facet_group=2
+    ).all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_forward_rejects_sampled_residency():
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    fcs = make_full_facet_cover(config)
+    with pytest.raises(ValueError, match="sampled"):
+        StreamedForward(
+            config,
+            [(fc, np.zeros((fc.size, fc.size))) for fc in fcs],
+            residency="sampled",
+        )
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize("fold_group", [1, 3])
+def test_sampled_backward_matches_fft_backward(backend, fold_group):
+    """The adjoint-sampled einsum fold == the FFT-based facet pass."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+    ref_b = StreamedBackward(config, facet_configs, residency="device")
+    ref_b.add_subgrids(tasks)
+    ref = ref_b.finish()
+    out_b = StreamedBackward(
+        config, facet_configs, residency="sampled", fold_group=fold_group
+    )
+    out_b.add_subgrids(tasks)
+    out = out_b.finish()
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_sampled_backward_roundtrip_device_stack():
+    """Forward device columns feed the sampled backward with NO host
+    round trip (`add_subgrid_stack`); the round trip matches the oracle
+    at the reference's own 3e-10 threshold."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    for items, out in fwd.stream_columns(
+        subgrid_configs, device_arrays=True
+    ):
+        bwd.add_subgrid_stack([sg for _, sg in items], out[: len(items)])
+    facets = bwd.finish()
+    for i, fc in enumerate(facet_configs):
+        err = check_facet(
+            config.image_size, fc, config.core.as_complex(facets[i]), SOURCES
+        )
+        assert err < 3e-10
+
+
+def test_sampled_backward_checkpoint(tmp_path):
+    """Sampled-residency snapshots restore exactly; cross-residency
+    restores fail loudly."""
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+    half = len(tasks) // 2
+
+    b1 = StreamedBackward(config, facet_configs, residency="sampled")
+    b1.add_subgrids(tasks[:half])
+    path = tmp_path / "ck.npz"
+    save_streamed_backward_state(
+        path, b1, [(sg.off0, sg.off1) for sg, _ in tasks[:half]]
+    )
+
+    b2 = StreamedBackward(config, facet_configs, residency="sampled")
+    done = restore_streamed_backward_state(path, b2)
+    assert len(done) == half
+    b2.add_subgrids(tasks[half:])
+    out = b2.finish()
+
+    ref_b = StreamedBackward(config, facet_configs, residency="sampled")
+    ref_b.add_subgrids(tasks)
+    ref = ref_b.finish()
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+    b3 = StreamedBackward(config, facet_configs, residency="device")
+    with pytest.raises(ValueError, match="residency"):
+        restore_streamed_backward_state(path, b3)
+
+
+def test_sampled_backward_mesh_matches_single_device():
+    """The sampled backward on a facet-sharded mesh == single device."""
+    from swiftly_tpu.parallel.mesh import make_facet_mesh
+
+    mesh = make_facet_mesh()
+
+    def run(config):
+        facet_configs = make_full_facet_cover(config)
+        subgrid_configs = make_full_subgrid_cover(config)
+        facet_tasks = [
+            (fc, make_facet(config.image_size, fc, SOURCES))
+            for fc in facet_configs
+        ]
+        fwd = StreamedForward(config, facet_tasks, col_block=416)
+        subgrids = fwd.all_subgrids(subgrid_configs)
+        bwd = StreamedBackward(config, facet_configs, residency="sampled")
+        bwd.add_subgrids(
+            [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+        )
+        return bwd.finish()
+
+    ref = run(SwiftlyConfig(backend="jax", **TEST_PARAMS))
+    out = run(SwiftlyConfig(backend="jax", mesh=mesh, **TEST_PARAMS))
+    np.testing.assert_allclose(out, ref, atol=1e-13)
+
+
+def test_grouped_budget_accounting():
+    from swiftly_tpu.parallel.streamed import grouped_col_group_for_budget
+
+    config, _, _, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks)
+    base = fwd._base
+    # huge budget -> capped at the (chunk-rounded) column count
+    assert grouped_col_group_for_budget(base, 1e15, 40, 5, 228, True, 1, 4) == 40
+    # tiny budget -> floor of one chunk
+    assert grouped_col_group_for_budget(base, 1.0, 40, 5, 228, True, 1, 4) == 4
+    # monotone in budget
+    gs = [
+        grouped_col_group_for_budget(base, b, 10**6, 5, 228, True, 1, 4)
+        for b in (1e9, 4e9, 16e9, 64e9)
+    ]
+    assert gs == sorted(gs)
