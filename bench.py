@@ -39,12 +39,15 @@ device-side residuals (n_rms_samples in the output records the count).
 """
 
 import json
+import logging
 import os
 import sys
 import time
 import traceback
 
 import numpy as np
+
+log = logging.getLogger("bench")
 
 
 def _build(backend, params, dtype=None, streamed=False):
@@ -63,20 +66,28 @@ def _build(backend, params, dtype=None, streamed=False):
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
-        # lazy facet construction: StreamedForward converts each facet to
-        # its compact layout (real plane) one at a time — at 64k this
-        # bounds host peak to ONE 8 GB complex facet + the f32 planes,
-        # instead of the full 73 GB complex stack
+        # lazy sparse real-plane facet construction: point-source facets
+        # are zeros plus a few mask-scaled pixels, so build the f32 real
+        # planes directly (== make_facet(...).real, pinned by tests) —
+        # the dense complex build costs ~minutes of host time per 64k
+        # facet and 4x the RAM
+        from swiftly_tpu import make_real_facet
+
+        rdt = np.float32 if dtype is None else np.dtype(dtype)
         facet_tasks = [
-            (fc, (lambda fc=fc: make_facet(config.image_size, fc, sources)))
+            (fc, (lambda fc=fc: make_real_facet(
+                config.image_size, fc, sources, dtype=rdt)))
             for fc in facet_configs
         ]
         col_group = int(os.environ.get("BENCH_COL_GROUP", "0")) or None
         facet_group = int(os.environ.get("BENCH_FACET_GROUP", "0")) or None
+        t0 = time.time()
         fwd = StreamedForward(
             config, facet_tasks, residency="device", col_group=col_group,
             facet_group=facet_group,
         )
+        log.info("facet data built+laid out in %.1fs (real=%s)",
+                 time.time() - t0, fwd._facets_real)
     else:
         facet_tasks = [
             (fc, make_facet(config.image_size, fc, sources))
@@ -104,6 +115,7 @@ def _oracle_sample_stack(config, subgrid_configs, sources, min_n=100,
     n_s = min(n, max(min_n, int(n * target_pct / 100)))
     stride = max(1, n // n_s)
     idxs = list(range(0, n, stride))
+    t0 = time.time()
     core = config.core
     host = []
     for i in idxs:
@@ -117,7 +129,10 @@ def _oracle_sample_stack(config, subgrid_configs, sources, min_n=100,
             )
         else:
             host.append(np.asarray(ref, dtype=core.dtype))
-    return {i: k for k, i in enumerate(idxs)}, jnp.asarray(np.stack(host))
+    stack = jnp.asarray(np.stack(host))
+    log.info("oracle sample stack: %d subgrids (%.2f GiB) in %.1fs",
+             len(idxs), stack.nbytes / 2**30, time.time() - t0)
+    return {i: k for k, i in enumerate(idxs)}, stack
 
 
 def _rms2_device(core, got, want):
@@ -304,10 +319,20 @@ def run_one(config_name, mode):
             float(np.asarray(acc))
             return float(np.asarray(max_rms2)) ** 0.5
 
-        run_streamed()  # warmup: compile + facet upload
+        log.info("streamed: warmup pass (compile + facet upload)")
         t0 = time.time()
-        rms = run_streamed()
-        elapsed = time.time() - t0
+        warm_rms = run_streamed()  # warmup: compile + facet upload
+        t_cold = time.time() - t0
+        log.info("streamed: warmup done in %.1fs; timed pass", t_cold)
+        if os.environ.get("BENCH_SKIP_WARM_PASS"):
+            # diagnosis mode: report the cold pass (incl. compiles)
+            rms, elapsed = warm_rms, t_cold
+            extra["includes_compile"] = True
+        else:
+            t0 = time.time()
+            rms = run_streamed()
+            elapsed = time.time() - t0
+        log.info("streamed: timed %.1fs", elapsed)
         extra["n_rms_samples"] = len(sample_map)
         extra["rms_sample_pct"] = round(
             100 * len(sample_map) / len(subgrid_configs), 2
@@ -322,6 +347,23 @@ def run_one(config_name, mode):
         from swiftly_tpu.parallel import StreamedBackward
 
         fold_group = int(os.environ.get("BENCH_FOLD_GROUP", "4"))
+
+        # the backward's image-space accumulator + its pending row buffer
+        # share the chip with the forward: reserve them out of the budget
+        # the forward's auto-sizers see (at 32k this tips the forward into
+        # facet-slab streaming, which is the point — the accumulator is
+        # the bigger resident and the facets re-stream around it)
+        core = config.core
+        yB = facet_configs[0].size
+        per_el = np.dtype(core.dtype).itemsize * (
+            2 if core.backend == "planar" else 1
+        )
+        F_total = fwd.stack.n_total
+        acc_bytes = F_total * yB * yB * per_el
+        rows_bytes = (
+            fold_group * F_total * core.xM_yN_size * yB * per_el
+        )
+        fwd.hbm_headroom = int(acc_bytes + rows_bytes)
 
         def run_roundtrip_streamed():
             """StreamedForward -> sampled-residency StreamedBackward,
@@ -426,8 +468,14 @@ def run_one(config_name, mode):
         )
 
     # --- numpy reference baseline ----------------------------------------
+    log.info("numpy baseline measurement")
     baseline_estimated = streamed_mode
-    if baseline_estimated:
+    env_baseline = os.environ.get("BENCH_NUMPY_BASELINE_S")
+    if baseline_estimated and env_baseline:
+        # operator-supplied (e.g. from a prior run of the same config):
+        # the 64k-scale sampled sub-ops alone take minutes of host time
+        numpy_total = float(env_baseline)
+    elif baseline_estimated:
         numpy_total = _numpy_baseline_from_parts(params, sources)
         if mode == "roundtrip-streamed":
             # extrapolate the backward leg by the analytic FLOP ratio of
@@ -513,6 +561,13 @@ def run_one(config_name, mode):
 def main():
     from swiftly_tpu.utils import enable_compilation_cache
 
+    # progress visibility for the hour-scale configs: BENCH_LOGLEVEL=INFO
+    # streams per-phase and per-sweep lines to stderr
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
     enable_compilation_cache()
 
     legacy = os.environ.get("BENCH_CONFIG")

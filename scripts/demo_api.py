@@ -31,6 +31,57 @@ from scripts.utils import (
 log = logging.getLogger("swiftly-tpu.demo")
 
 
+def run_streamed_with_checkpoint(
+    fwd, bwd, subgrid_configs, ck_path=None, every=8, on_column=None
+):
+    """The streamed forward->backward loop with optional checkpointing.
+
+    Folds each forward column into `bwd`; with `ck_path`, snapshots the
+    backward accumulators every `every` columns (atomic tmp+rename) and,
+    if the file already exists, RESUMES: previously folded columns are
+    skipped (their forward compute is repeated — the forward is
+    stateless — but hours of backward accumulation are not lost).
+    Returns the finished facets. `on_column(items)` is a progress hook
+    (also the kill point of the resume test).
+    """
+    import os
+
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    processed = set()
+    if ck_path is not None and Path(ck_path).exists():
+        processed = set(
+            tuple(p) for p in restore_streamed_backward_state(ck_path, bwd)
+        )
+        log.info(
+            "resumed from %s: %d subgrids already folded",
+            ck_path, len(processed),
+        )
+    cols_since_save = 0
+    for items, subgrids in fwd.stream_columns(subgrid_configs):
+        keys = [(sg.off0, sg.off1) for _, sg in items]
+        if processed and all(k in processed for k in keys):
+            continue
+        # identity "processing" step sits here in a real pipeline
+        bwd.add_subgrids(
+            [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+        )
+        processed.update(keys)
+        cols_since_save += 1
+        if on_column is not None:
+            on_column(items)
+        if ck_path is not None and cols_since_save >= every:
+            tmp = str(ck_path) + ".tmp.npz"
+            save_streamed_backward_state(tmp, bwd, sorted(processed))
+            os.replace(tmp, ck_path)
+            cols_since_save = 0
+            log.info("checkpoint: %d subgrids folded", len(processed))
+    return bwd.finish()
+
+
 def demo_api(args, params, config_name=""):
     """Run one config end-to-end; returns max facet RMS error."""
     from swiftly_tpu import (
@@ -106,16 +157,23 @@ def demo_api(args, params, config_name=""):
                 [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)],
             )
         elif streamed:
-            done = 0
-            for items, subgrids in fwd.stream_columns(subgrid_configs):
-                # identity "processing" step sits here in a real pipeline
-                bwd.add_subgrids(
-                    [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
-                )
-                done += len(items)
-                log.info("column done: %d/%d subgrids", done,
+            progress = {"done": 0}
+
+            def on_column(items):
+                progress["done"] += len(items)
+                log.info("column done: %d/%d subgrids", progress["done"],
                          len(subgrid_configs))
-            facets = bwd.finish()
+
+            ck_path = None
+            if args.checkpoint:
+                ck_dir = Path(args.checkpoint)
+                ck_dir.mkdir(parents=True, exist_ok=True)
+                tag = f"{config_name or 'run'}-{args.execution}"
+                ck_path = ck_dir / f"bwd_{tag.replace('/', '_')}.npz"
+            facets = run_streamed_with_checkpoint(
+                fwd, bwd, subgrid_configs, ck_path=ck_path,
+                every=args.checkpoint_every, on_column=on_column,
+            )
         else:
             for i, sg_config in enumerate(subgrid_configs):
                 subgrid = fwd.get_subgrid_task(sg_config)

@@ -110,6 +110,21 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
              "(run the same command on every host)",
     )
     parser.add_argument(
+        "--checkpoint",
+        type=str,
+        default=None,
+        help="streamed executions: snapshot the backward accumulators to "
+             "this directory every --checkpoint_every columns and "
+             "auto-resume from an existing snapshot (long 32k+ runs "
+             "survive preemption)",
+    )
+    parser.add_argument(
+        "--checkpoint_every",
+        type=int,
+        default=8,
+        help="columns between checkpoint snapshots",
+    )
+    parser.add_argument(
         "--profile_dir",
         type=str,
         default=None,

@@ -20,6 +20,7 @@ from .api import (
     check_residual,
     check_subgrid,
     make_facet,
+    make_real_facet,
     make_full_facet_cover,
     make_full_subgrid_cover,
     make_sparse_facet_cover,
@@ -50,6 +51,7 @@ __all__ = [
     "check_residual",
     "check_subgrid",
     "make_facet",
+    "make_real_facet",
     "make_facet_from_sources",
     "make_full_facet_cover",
     "make_full_subgrid_cover",

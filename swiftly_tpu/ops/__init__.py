@@ -9,6 +9,7 @@ from .io_slices import (
 from .oracle import (
     generate_masks,
     make_facet_from_sources,
+    make_real_facet_plane_from_sources,
     make_subgrid_from_sources,
     mask_from_slices,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "roll_and_extract_mid_axis",
     "generate_masks",
     "make_facet_from_sources",
+    "make_real_facet_plane_from_sources",
     "make_subgrid_from_sources",
     "mask_from_slices",
     "pswf_fb",

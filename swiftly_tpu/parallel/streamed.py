@@ -44,8 +44,21 @@ batched path, so streamed and batched results are numerically identical.
 from __future__ import annotations
 
 import functools
+import logging
+import time
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _rss_gib():
+    """Resident set size in GiB (cheap /proc read; 0.0 if unavailable)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * 4096 / 2**30
+    except Exception:  # pragma: no cover - non-linux
+        return 0.0
 
 from ..ops.core import (
     add_to_facet_math,
@@ -1259,6 +1272,12 @@ class StreamedForward:
         # slab i-2's column step (8-byte checksum pull — block_until_ready
         # is not completion on tunnel runtimes), bounding live slabs to 2.
         pending = collections.deque()
+        t_start = time.time()
+        logger.info(
+            "grouped stream: %d columns in groups of %d (chunk %d), "
+            "%d facet slabs of %d per group",
+            len(col_offs0), G, chunk, n_slabs, Fg,
+        )
         for g0 in range(0, len(col_offs0), G):
             grp = col_offs0[g0 : g0 + G]
             grp_padded = grp + [grp[-1]] * (G - len(grp))
@@ -1302,6 +1321,14 @@ class StreamedForward:
                     m1_c,
                 )
                 pending.append(jnp.sum(acc))
+                if logger.isEnabledFor(logging.INFO):
+                    logger.info(
+                        "  group %d/%d slab %d/%d dispatched  t=%.0fs "
+                        "rss=%.1fGiB",
+                        g0 // G + 1, -(-len(col_offs0) // G),
+                        s0 // Fg + 1, n_slabs,
+                        time.time() - t_start, _rss_gib(),
+                    )
             for gi, off0 in enumerate(grp):
                 prog_items = groups[off0]
                 items = [it for it in prog_items if it[0] is not None]

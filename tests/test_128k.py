@@ -1,0 +1,133 @@
+"""The 128k scale boundary: yN = 65536 is the largest padded facet size
+in the catalogue (`128k[1]-n32k-512`, reference swift_configs.py:30) and
+EXACTLY the limit of the sampled path's exact int32 modular phase
+arithmetic (`streamed._mulmod` splits one operand into 8-bit limbs; every
+partial product must stay below 2**31, which holds iff yN <= 2**16).
+
+These tests pin that boundary and prove the streamed machinery builds and
+runs at N = 131072 with the full yN = 65536 on a CPU-sized proxy (small
+facets, partial cover — one real 45056**2 facet is 32 GB of complex128,
+not a unit-test object; the phase arithmetic and program shapes the
+boundary threatens depend on yN and N, not on yB).
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.parallel.streamed import _mulmod, sampled_row_indices
+
+
+class _GeomCore:
+    """Just the geometry sampled_row_indices reads (no PSWF needed)."""
+
+    def __init__(self, N, xM_size, yN_size):
+        self.N = N
+        self.xM_size = xM_size
+        self.yN_size = yN_size
+        self.xM_yN_size = xM_size * yN_size // N
+
+
+def test_mulmod_exact_at_yn_65536():
+    """(a*b) mod 65536 in int32 limb arithmetic == int64 ground truth,
+    including the largest operands the 128k sampled paths produce."""
+    import jax.numpy as jnp
+
+    yN = 65536
+    rng = np.random.default_rng(0)
+    # centred spectral rows span [-yN//2, yN//2); column/data indices span
+    # [0, yB) with yB = 45056 at 128k; also hit the exact corners
+    a = np.concatenate(
+        [
+            rng.integers(-(yN // 2), yN // 2, size=4096),
+            [-(yN // 2), yN // 2 - 1, 0, 1, -1],
+        ]
+    ).astype(np.int32)
+    b = np.concatenate(
+        [
+            rng.integers(0, 45056, size=4096),
+            [0, 1, 45055, yN - 1, yN // 2],
+        ]
+    ).astype(np.int32)
+    got = np.asarray(_mulmod(jnp.asarray(a), jnp.asarray(b), yN))
+    want = (a.astype(np.int64) * b.astype(np.int64)) % yN
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_mulmod_rejects_beyond_boundary():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError, match="65536"):
+        _mulmod(jnp.asarray([1]), jnp.asarray([1]), 1 << 17)
+
+
+def test_sampled_row_indices_128k_geometry():
+    """Row indices at the real 128k[1]-n32k-512 geometry: centred range,
+    int32, and equal to the definition evaluated in int64."""
+    core = _GeomCore(N=131072, xM_size=512, yN_size=65536)
+    m, yN = core.xM_yN_size, core.yN_size
+    assert m == 256
+    # every legal column offset is a multiple of N/yN = 2; take a spread
+    offs = [0, 2, 446, 65534, 131070]
+    krows = sampled_row_indices(core, offs)
+    assert krows.dtype == np.int32
+    assert krows.shape == (len(offs) * m,)
+    assert krows.min() >= -(yN // 2) and krows.max() < yN // 2
+    r = np.arange(m, dtype=np.int64)
+    for ci, off0 in enumerate(offs):
+        s = off0 * yN // core.N
+        want = (yN // 2 - m // 2 + s + ((r - s) % m)) % yN - yN // 2
+        np.testing.assert_array_equal(
+            krows[ci * m : (ci + 1) * m].astype(np.int64), want
+        )
+
+
+def test_128k_proxy_streamed_forward_vs_oracle():
+    """StreamedForward (sampled path) at N=131072 with the FULL
+    yN = 65536 — the boundary value — against the direct-DFT oracle.
+
+    Proxy geometry: small facets (yB=1024) and a 2x2 corner of the
+    cover; the modular phase arithmetic, wrapped windows and program
+    construction all see the true 128k N and yN. The oracle comparison
+    is exact-cover-valid because the single point source lies wholly
+    inside facet (0,0) — every absent facet's data is identically zero,
+    so the 2-facet contribution sum equals the full-cover sum.
+    """
+    from swiftly_tpu import SwiftlyConfig, check_subgrid
+    from swiftly_tpu.models.config import FacetConfig, SubgridConfig
+    from swiftly_tpu.parallel import StreamedForward
+    from swiftly_tpu.ops.oracle import make_facet_from_sources
+
+    params = dict(
+        W=13.5625, fov=1.0, N=131072, yB_size=1024, yN_size=65536,
+        xA_size=448, xM_size=512,
+    )
+    config = SwiftlyConfig(backend="jax", **params)
+    sources = [(1.0, 3, -5)]
+    # two facets along axis 1 (offsets: multiples of N/xM = 256), both
+    # containing the sources' pixel neighbourhood via wrapping
+    facet_configs = [
+        FacetConfig(0, 0, 1024),
+        FacetConfig(0, 768, 1024),
+    ]
+    facet_tasks = [
+        (
+            fc,
+            make_facet_from_sources(
+                sources, config.image_size, fc.size, [fc.off0, fc.off1]
+            ),
+        )
+        for fc in facet_configs
+    ]
+    # a 2x2 corner of the subgrid cover (offsets: multiples of N/yN = 2)
+    subgrid_configs = [
+        SubgridConfig(o0, o1, 448)
+        for o0 in (0, 448)
+        for o1 in (0, 448)
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    out = fwd.all_subgrids(subgrid_configs)
+    for i, sg in enumerate(subgrid_configs):
+        err = check_subgrid(
+            config.image_size, sg, config.core.as_complex(out[i]), sources
+        )
+        assert err < 1e-8

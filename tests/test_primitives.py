@@ -211,3 +211,29 @@ def test_generate_masks_partition():
 def test_mask_from_slices():
     m = mask_from_slices([slice(0, 3), slice(5, 7)], 8)
     np.testing.assert_array_equal(m, [1, 1, 1, 0, 0, 1, 1, 0])
+
+
+def test_real_facet_plane_equals_dense_build():
+    """make_real_facet_plane_from_sources == make_facet_from_sources.real
+    (the sparse builder the large-N drivers feed to the streamed path)."""
+    import numpy as np
+
+    from swiftly_tpu.ops.oracle import (
+        make_facet_from_sources,
+        make_real_facet_plane_from_sources,
+    )
+
+    sources = [(1.0, 1, 0), (0.5, -30, 40), (2.25, 100, -100)]
+    rng = np.random.default_rng(5)
+    masks = [rng.integers(0, 2, size=256).astype(float), None]
+    dense = make_facet_from_sources(sources, 1024, 256, [0, 256], masks)
+    assert np.all(dense.imag == 0)
+    sparse = make_real_facet_plane_from_sources(
+        sources, 1024, 256, [0, 256], masks, dtype=np.float64
+    )
+    np.testing.assert_array_equal(sparse, dense.real)
+    # wrapped source (outside the facet window) contributes nothing
+    none = make_real_facet_plane_from_sources(
+        [(1.0, 500, 500)], 1024, 256, [0, 256], masks
+    )
+    assert not np.any(none)
