@@ -611,6 +611,25 @@ def _facet_pass_sampled_sharded(core, mesh, real_facets=False):
 # no host round trip, no d2h until the final (verified-on-device) facets.
 
 
+def _fold_row_block(F, yB, itemsize):
+    """Static output-row block size for the adjoint fold's scan.
+
+    The fold's einsum transients are [F, B, yB]-shaped; bounding B keeps
+    each one to ~SWIFTLY_FOLD_BLOCK_MB (default 192) regardless of yB —
+    the unblocked fold materialised a full [F, yB, yB, 2] (~2x the
+    accumulator, ~18 GiB at 32k) next to the donated accumulator, which
+    is exactly what OOM'd the 32k round trip on a 16 GiB chip.
+    """
+    import os
+
+    target = float(os.environ.get("SWIFTLY_FOLD_BLOCK_MB", "192")) * 1e6
+    per_row = max(1, F * yB * itemsize)
+    B = int(target // per_row)
+    if B >= yB:
+        return yB
+    return max(1, (B // 128) * 128 or B)
+
+
 @functools.lru_cache(maxsize=None)
 def _bwd_sampled_fold_fn(core):
     """acc [F, yB, yB(,2)] += adjoint-sampled fold of rows [F, R, yB(,2)].
@@ -620,6 +639,14 @@ def _bwd_sampled_fold_fn(core):
     axis 1); `krows` their centred spectral indices; `e0` the per-facet
     embedding shifts. Validated against the FFT-based `_facet_pass_bwd`
     by tests/test_streamed.py.
+
+    The fold accumulates in bounded output-row blocks (`_fold_row_block`)
+    via a lax.scan whose carry is the donated accumulator: per block one
+    [F, B, yB]-shaped einsum lands in acc through a dynamic slice update,
+    so peak transient memory is a few blocks, not a second full
+    accumulator. The final (clamped) block re-covers rows the previous
+    block already folded; `keep` zeroes those contributions, making the
+    tiling exact for any yB.
     """
     import jax.numpy as jnp
 
@@ -632,7 +659,7 @@ def _bwd_sampled_fold_fn(core):
     if _planar(core):
 
         def fn(acc, rows, e0, krows):
-            yB = acc.shape[1]
+            F, yB = acc.shape[0], acc.shape[1]
             dt = acc.dtype
             fb = core._p.extract_mid(core._Fb, yB, 0)  # [yB] real, no 1/yN
             # conjugate per-facet phase: rows * w^{-e0_f kt_r}
@@ -644,34 +671,87 @@ def _bwd_sampled_fold_fn(core):
             Rr, Ri = rows[..., 0], rows[..., 1]
             Rr2 = Rr * p_cos + Ri * p_sin
             Ri2 = Ri * p_cos - Rr * p_sin
-            i = jnp.arange(yB, dtype=jnp.int32)
-            b_cos, b_sin = phases(_mulmod(krows[:, None], i[None, :], yN))
-            Bc = b_cos.astype(dt)
-            Bs = b_sin.astype(dt)
             from ..ops.planar_backend import _PRECISION
 
             f = lambda a, b: jnp.einsum(
                 "ri,frj->fij", a, b, precision=_PRECISION
             )
-            out_re = f(Bc, Rr2) + f(Bs, Ri2)
-            out_im = f(Bc, Ri2) - f(Bs, Rr2)
-            out = jnp.stack([out_re, out_im], axis=-1)
-            return acc + out * fb[None, :, None, None]
+            B = _fold_row_block(F, yB, np.dtype(dt).itemsize)
+            n_blk = -(-yB // B)
+            fbj = jnp.asarray(fb, dt)
+
+            def body(carry, xs):
+                i0, start = xs
+                i = start + jnp.arange(B, dtype=jnp.int32)
+                keep = (i >= i0).astype(dt)
+                b_cos, b_sin = phases(
+                    _mulmod(krows[:, None], i[None, :], yN)
+                )
+                Bc = b_cos.astype(dt)
+                Bs = b_sin.astype(dt)
+                out_re = f(Bc, Rr2) + f(Bs, Ri2)
+                out_im = f(Bc, Ri2) - f(Bs, Rr2)
+                w = jax.lax.dynamic_slice_in_dim(fbj, start, B) * keep
+                out = jnp.stack([out_re, out_im], axis=-1)
+                out = out * w[None, :, None, None]
+                z = jnp.int32(0)
+                cur = jax.lax.dynamic_slice(
+                    carry, (z, start, z, z), (F, B, yB, 2)
+                )
+                return (
+                    jax.lax.dynamic_update_slice(
+                        carry, cur + out, (z, start, z, z)
+                    ),
+                    None,
+                )
+
+            i0s = jnp.arange(n_blk, dtype=jnp.int32) * B
+            starts = jnp.minimum(i0s, yB - B)
+            acc, _ = jax.lax.scan(body, acc, (i0s, starts))
+            return acc
 
     else:
 
         def fn(acc, rows, e0, krows):
-            yB = acc.shape[1]
+            F, yB = acc.shape[0], acc.shape[1]
             fb = core._p.extract_mid(core._Fb, yB, 0)
             p_cos, p_sin = phases(
                 _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
             )
             phi = (p_cos - 1j * p_sin).astype(core.dtype)  # [F, R]
-            i = jnp.arange(yB, dtype=jnp.int32)
-            b_cos, b_sin = phases(_mulmod(krows[:, None], i[None, :], yN))
-            B = (b_cos - 1j * b_sin).astype(core.dtype)  # [R, yB_i]
-            out = jnp.einsum("ri,frj->fij", B, rows * phi[..., None])
-            return acc + out * fb[None, :, None]
+            rows2 = rows * phi[..., None]
+            B = _fold_row_block(F, yB, np.dtype(core.dtype).itemsize)
+            n_blk = -(-yB // B)
+            fbj = jnp.asarray(fb)
+
+            def body(carry, xs):
+                i0, start = xs
+                i = start + jnp.arange(B, dtype=jnp.int32)
+                keep = i >= i0
+                b_cos, b_sin = phases(
+                    _mulmod(krows[:, None], i[None, :], yN)
+                )
+                Bm = (b_cos - 1j * b_sin).astype(core.dtype)  # [R, B]
+                out = jnp.einsum("ri,frj->fij", Bm, rows2)
+                w = jnp.where(
+                    keep, jax.lax.dynamic_slice_in_dim(fbj, start, B), 0
+                )
+                out = out * w[None, :, None].astype(core.dtype)
+                z = jnp.int32(0)
+                cur = jax.lax.dynamic_slice(
+                    carry, (z, start, z), (F, B, yB)
+                )
+                return (
+                    jax.lax.dynamic_update_slice(
+                        carry, cur + out, (z, start, z)
+                    ),
+                    None,
+                )
+
+            i0s = jnp.arange(n_blk, dtype=jnp.int32) * B
+            starts = jnp.minimum(i0s, yB - B)
+            acc, _ = jax.lax.scan(body, acc, (i0s, starts))
+            return acc
 
     return fn
 
@@ -785,6 +865,11 @@ class _StreamedBase:
         if residency not in ("host", "device", "sampled"):
             raise ValueError(
                 f"residency must be host|device|sampled, got {residency}"
+            )
+        if not facet_configs:
+            raise ValueError(
+                "facet_configs must be non-empty (the streamed paths "
+                "size their programs from the first facet)"
             )
         self.residency = residency
         self.stack = _FacetStack(
@@ -1190,6 +1275,17 @@ class StreamedForward:
         col_offs0 = list(groups)
         first_col = next(iter(groups.values()))
         S = len(first_col)
+        # slab pipeline depth: 2 overlaps upload with compute; at scales
+        # where two slabs alone would eat half the budget (128k: one slab
+        # is 8.1 GiB) fall back to 1 slab in flight
+        budget = self._hbm_budget()
+        fsize = np.dtype(core.dtype).itemsize * (
+            1 if self._facets_real else (2 if _planar(core) else 1)
+        )
+        slab_bytes = Fg * yB * yB * fsize
+        depth = 2
+        if budget is not None and 2 * slab_bytes > 0.5 * budget:
+            depth = 1
         chunk = 4
         if self.col_group:
             # honour an explicit G exactly: pick the largest chunk that
@@ -1197,21 +1293,20 @@ class StreamedForward:
             G = max(1, int(self.col_group))
             chunk = next(c for c in (4, 3, 2, 1) if G % c == 0)
         else:
-            budget = self._hbm_budget()
             if budget is None:
                 G = len(col_offs0)
                 chunk = next(c for c in (4, 3, 2, 1) if G % c == 0)
             else:
                 G = grouped_col_group_for_budget(
                     base, budget, len(col_offs0), S, subgrid_size,
-                    self._facets_real, Fg, chunk,
+                    self._facets_real, Fg, chunk, slab_depth=depth,
                 )
         chunk = min(chunk, G)
         G = (G // chunk) * chunk
         n_chunks = G // chunk
         self.last_plan = {
             "mode": "grouped", "col_group": G, "facet_group": Fg,
-            "n_slabs": n_slabs,
+            "n_slabs": n_slabs, "slab_depth": depth,
         }
 
         # per-slab facet metadata, padded with zero facets to F_pad
@@ -1302,9 +1397,13 @@ class StreamedForward:
             acc = jnp.zeros(
                 (n_chunks, chunk, S, xA, xA) + tail, dtype=_np_dtype(core)
             )
+            slab_dev = None
             for s0 in range(0, F_pad, Fg):
-                while len(pending) >= 2:
+                while len(pending) >= depth:
                     np.asarray(pending.popleft())
+                # drop the previous slab BEFORE uploading the next: at
+                # depth 1 both must never be live together
+                slab_dev = None  # noqa: F841 - releases device buffers
                 slab_dev = tuple(base._place(a) for a in host_slab(s0))
                 buf = samfn(
                     *slab_dev,
@@ -1337,8 +1436,10 @@ class StreamedForward:
     def _hbm_budget(self):
         """Per-device HBM budget in bytes (None = unlimited, e.g. CPU).
 
-        SWIFTLY_HBM_BUDGET (bytes) if set, else 90% of the device's
-        reported capacity (`memory_stats()["bytes_limit"]`), else 14e9.
+        SWIFTLY_HBM_BUDGET (bytes) if set, else the USABLE capacity from
+        `utils.profiling.probe_hbm_bytes` (runtime-reported memory_stats
+        when available, else a measured per-device-kind table — margins
+        applied inside the probe), else 14e9 as a last resort.
         """
         import os
 
@@ -1350,11 +1451,10 @@ class StreamedForward:
         env = os.environ.get("SWIFTLY_HBM_BUDGET")
         if env:
             return float(env) - self.hbm_headroom
-        try:
-            limit = (device.memory_stats() or {}).get("bytes_limit", 0)
-        except Exception:  # pragma: no cover - backend-specific
-            limit = 0
-        return (0.9 * limit if limit else 14e9) - self.hbm_headroom
+        from ..utils.profiling import probe_hbm_bytes
+
+        limit = probe_hbm_bytes(device) or 14e9
+        return limit - self.hbm_headroom
 
     def _facet_stack_fits(self):
         """Whether the whole facet stack can stay device-resident with
@@ -1402,13 +1502,15 @@ def facet_stack_bytes(base, real=False):
 
 
 def grouped_col_group_for_budget(
-    base, budget, n_cols, S, subgrid_size, real, facet_group, chunk
+    base, budget, n_cols, S, subgrid_size, real, facet_group, chunk,
+    slab_depth=2,
 ):
     """Largest column-group G for the facet-slab-streamed sampled path.
 
     Live per unit G: the slab's sampled buffer [Fg, m, yB] plus its
     in-step [G, Fg, m, yB] transpose, and the finished accumulator row
-    [S, xA, xA]. Flat: two facet slabs in flight (depth-2 pipeline), the
+    [S, xA, xA]. Flat: `slab_depth` facet slabs in flight (the upload
+    pipeline; 1 at scales where two slabs alone overflow HBM), the
     per-chunk scan transients ([chunk, S, xM, xM] carry + prep1 rows),
     and a trig/fragmentation reserve.
     """
@@ -1419,7 +1521,7 @@ def grouped_col_group_for_budget(
     m = core.xM_yN_size
     xM = core.xM_size
     xA = subgrid_size
-    slab_b = 2 * facet_group * yB * yB * fsize
+    slab_b = slab_depth * facet_group * yB * yB * fsize
     chunk_b = (
         chunk * S * xM * xM + chunk * facet_group * m * core.yN_size
     ) * dsize
@@ -1427,7 +1529,20 @@ def grouped_col_group_for_budget(
         2 * facet_group * m * yB + S * xA * xA
     ) * dsize
     reserve = 0.6e9
-    G = int((budget - slab_b - chunk_b - reserve) // per_G)
+    headroom = budget - slab_b - chunk_b - reserve
+    if headroom <= per_G:
+        # a provably-unfittable plan must not proceed silently: the
+        # minimum group still gets dispatched (fail-soft callers catch
+        # the OOM and resize), but the operator is told why
+        logger.warning(
+            "HBM budget %.2f GiB cannot fit even one %d-column chunk "
+            "(flat costs %.2f GiB + %.2f GiB per column group); "
+            "proceeding with the minimum group — expect OOM, reduce "
+            "facet_group or raise SWIFTLY_HBM_BUDGET",
+            budget / 2**30, chunk,
+            (slab_b + chunk_b + reserve) / 2**30, per_G / 2**30,
+        )
+    G = int(headroom // per_G)
     G = max(chunk, (G // chunk) * chunk)
     return min(G, ((n_cols + chunk - 1) // chunk) * chunk)
 
@@ -1463,7 +1578,16 @@ def col_group_for_budget(base, budget, n_cols, real=False):
         2 * F * m * yB + F * m * core.yN_size
         + S * xM * xM + 2 * S * xA * xA
     ) * dsize
-    G = int((budget - facets_b - reserve) // col_b)
+    headroom = budget - facets_b - reserve
+    if headroom <= col_b:
+        logger.warning(
+            "HBM budget %.2f GiB cannot fit the resident facet stack "
+            "(%.2f GiB) plus one column group (%.2f GiB); proceeding "
+            "with G=1 — expect OOM, use facet_group slab streaming or "
+            "raise SWIFTLY_HBM_BUDGET",
+            budget / 2**30, facets_b / 2**30, col_b / 2**30,
+        )
+    G = int(headroom // col_b)
     return max(1, min(n_cols, G))
 
 
@@ -1502,6 +1626,15 @@ class StreamedBackward:
         self._acc = None  # ("sampled") device [F, yB, yB(,2)] accumulator
         self._fold_group = max(1, int(fold_group))
         self._pending_rows = []  # ("sampled") [(off0, rows [F, m, yB(,2)])]
+        # ("sampled") depth-2 fold-completion pipeline: dispatch is
+        # asynchronous and block_until_ready is not completion on tunnel
+        # runtimes, so a checksum of each fold's output is pulled before
+        # dispatching the fold after next — bounding live fold transients
+        # and row buffers to two folds' worth (mirrors the forward's
+        # _device_columns/_grouped_device_columns pattern).
+        import collections
+
+        self._fold_inflight = collections.deque()
         self._finished = False
 
     def add_subgrids(self, tasks):
@@ -1612,7 +1745,13 @@ class StreamedBackward:
             foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
         else:
             foldfn = _bwd_sampled_fold_j(core)
+        # backpressure: drain to depth 1 before dispatching (genuine
+        # 8-byte host pulls — see _fold_inflight comment in __init__)
+        while len(self._fold_inflight) >= 2:
+            np.asarray(self._fold_inflight.popleft())
         self._acc = foldfn(self._acc, rows_cat, e0, krows)
+        # the checksum slice depends on the whole fold having executed
+        self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
         self._pending_rows = []
 
     def finish_device(self):

@@ -36,8 +36,76 @@ __all__ = [
     "collective_bytes_backward",
     "collective_bytes_forward",
     "device_memory_stats",
+    "probe_hbm_bytes",
     "trace",
 ]
+
+# probe_hbm_bytes result cache: None = not probed yet; 0 = probed, nothing
+# measurable; >0 = usable HBM bytes
+_probed_hbm = None
+
+# Usable single-buffer HBM bytes by device kind, for runtimes that report
+# no memory_stats at all (the tunnel-attached TPU this repo benches on).
+# The v5e figure is MEASURED on that runtime (fresh-process single-buffer
+# binary search, 2026-07-31: 16.5e9 allocates+sums fine, 17.0e9 fails;
+# 16.5e9 recorded with the failing bound as margin). A deliberate
+# over-allocation probe is NOT used: on this runtime allocation failures
+# surface asynchronously on LATER ops and poison the whole client — a
+# failed 64 GiB device_put "succeeds", then every subsequent allocation
+# throws RESOURCE_EXHAUSTED. Other rows are the published HBM sizes less
+# the same ~4% runtime reserve observed on v5e.
+_HBM_BY_KIND = {
+    "TPU v5 lite": 16.0e9,  # v5e: 16.5e9 measured, 0.5 GB multi-buffer margin
+    "TPU v5e": 16.0e9,
+    "TPU v5p": 91.0e9,  # 95 GB published
+    "TPU v4": 31.0e9,  # 32 GB published
+    "TPU v6e": 31.0e9,  # 32 GB published
+}
+
+
+def probe_hbm_bytes(device=None):
+    """USABLE accelerator-memory bytes for budget sizing (margins already
+    applied — callers subtract their own residents, not another safety
+    factor).
+
+    90% of `memory_stats()["bytes_limit"]` when the runtime reports it,
+    else the measured per-device-kind table above (those figures are
+    usable-as-measured, with a multi-buffer fragmentation margin baked
+    in). Returns None on CPU or unknown devices (callers fall back to
+    their own default). Result cached per process; SWIFTLY_HBM_PROBE=0
+    disables.
+    """
+    import os
+
+    global _probed_hbm
+    if os.environ.get("SWIFTLY_HBM_PROBE", "1") == "0":
+        return None
+    if _probed_hbm is not None:
+        return _probed_hbm or None
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    if device.platform == "cpu":
+        return None
+    try:
+        limit = (device.memory_stats() or {}).get("bytes_limit", 0)
+    except Exception:  # pragma: no cover - backend-specific
+        limit = 0
+    if limit:
+        limit = 0.9 * limit  # reported TOTAL -> usable
+    else:
+        kind = str(getattr(device, "device_kind", "")).lower()
+        for name, usable in _HBM_BY_KIND.items():
+            if name.lower() in kind:
+                limit = usable
+                logger.info(
+                    "memory_stats empty; using measured usable HBM for "
+                    "%s: %.2f GB", name, usable / 1e9,
+                )
+                break
+    _probed_hbm = int(limit)
+    return _probed_hbm or None
 
 
 @contextlib.contextmanager

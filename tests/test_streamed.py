@@ -361,6 +361,47 @@ def test_sampled_backward_matches_fft_backward(backend, fold_group):
     np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
+def test_sampled_fold_row_blocking(monkeypatch):
+    """The row-blocked adjoint fold — multiple blocks including a clamped
+    final block (416 % 100 != 0) — is exactly the single-block fold.
+
+    This is the 32k-OOM fix's correctness pin: blocking bounds the fold
+    transient to [F, B, yB] instead of a second full accumulator."""
+    from swiftly_tpu.parallel import streamed as st
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+
+    def run():
+        b = StreamedBackward(
+            config, facet_configs, residency="sampled", fold_group=2
+        )
+        b.add_subgrids(tasks)
+        # the fold-completion pipeline never holds more than 2 checksums
+        assert len(b._fold_inflight) <= 2
+        return b.finish()
+
+    ref = run()
+    st._bwd_sampled_fold_fn.cache_clear()
+    st._bwd_sampled_fold_j.cache_clear()
+    monkeypatch.setenv("SWIFTLY_FOLD_BLOCK_MB", "3")  # ~100-row blocks
+    assert st._fold_row_block(len(facet_configs), 416, 8) < 416
+    try:
+        out = run()
+    finally:
+        st._bwd_sampled_fold_fn.cache_clear()
+        st._bwd_sampled_fold_j.cache_clear()
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_streamed_rejects_empty_facets():
+    config = SwiftlyConfig(backend="planar", **TEST_PARAMS)
+    with pytest.raises(ValueError, match="non-empty"):
+        StreamedForward(config, [], residency="device")
+
+
 def test_sampled_backward_roundtrip_device_stack():
     """Forward device columns feed the sampled backward with NO host
     round trip (`add_subgrid_stack`); the round trip matches the oracle
