@@ -195,16 +195,29 @@ class FlightQueue:
     """
 
     def __init__(self, depth: int):
+        import os
+
         self.depth = depth
         self._inflight = []
+        # On runtimes whose block_until_ready returns before the dispatch
+        # queue has drained (the tunnel-attached TPU this repo benches
+        # on), blocking is not backpressure. With SWIFTLY_QUEUE_CHECKSUM=1
+        # `_ready` instead PULLS one element of each item to the host — a
+        # genuine device round trip that cannot complete before the
+        # producing computation has, so the queue-depth bound is real on
+        # such runtimes too (the streamed executors' built-in checksum
+        # pipelines use the same trick unconditionally).
+        self._checksum = os.environ.get("SWIFTLY_QUEUE_CHECKSUM") == "1"
 
-    @staticmethod
-    def _ready(item):
+    def _ready(self, item):
         # Accumulators are donated to their successor computation; a
         # queued buffer may therefore already be deleted by the time we
         # would block on it — its successor in the queue covers it.
         deleted = getattr(item, "is_deleted", None)
         if deleted is not None and deleted():
+            return
+        if self._checksum and hasattr(item, "ndim"):
+            np.asarray(item[(0,) * item.ndim])
             return
         if hasattr(item, "block_until_ready"):
             item.block_until_ready()

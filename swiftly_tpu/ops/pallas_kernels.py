@@ -44,7 +44,7 @@ def _kernel(zr_ref, zi_ref, wr_ref, wi_ref, or_ref, oi_ref):
     wr = wr_ref[...]
     wi = wi_ref[...]
     # HIGHEST matches the einsum path: default bf16 MXU passes would
-    # degrade the FFT to ~1e-3 relative error (see planar_backend._PRECISION).
+    # degrade the FFT to ~1e-3 relative error (see planar_backend.matmul_precision).
     dot = functools.partial(
         jnp.dot,
         preferred_element_type=or_ref.dtype,

@@ -154,13 +154,24 @@ def _twiddle(n1: int, n2: int, sign: int) -> tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(t.real), np.ascontiguousarray(t.imag)
 
 
-# TPU matmuls default to bfloat16 multiplications, which destroys FFT
-# accuracy (~1e-3 relative). HIGHEST forces full-f32 products (bf16x3
-# passes on the MXU) and recovers ~1e-7 relative error at f32.
-_PRECISION = jax.lax.Precision.HIGHEST
-
-
 import os
+
+
+# TPU matmuls default to bfloat16 multiplications, which destroys FFT
+# accuracy (~1e-3 relative). HIGHEST forces full-f32 products (multi-pass
+# bf16 on the MXU) and recovers ~1e-7 relative error at f32; HIGH costs
+# half of HIGHEST's MXU passes for ~1e-6 relative — inside this
+# pipeline's accuracy budget at f32, worth ~2x on einsum-bound stages.
+def matmul_precision():
+    """Einsum precision for the planar pipeline (read at TRACE time —
+    like SWIFTLY_CMATMUL, set SWIFTLY_PRECISION before the first
+    transform runs; highest|high|default)."""
+    name = os.environ.get("SWIFTLY_PRECISION", "highest").lower()
+    if name not in ("default", "high", "highest"):
+        raise ValueError(
+            f"SWIFTLY_PRECISION must be default|high|highest, got {name!r}"
+        )
+    return getattr(jax.lax.Precision, name.upper())
 
 
 def _cmatmul_algo() -> str:
@@ -189,7 +200,8 @@ def _cmatmul(zr, zi, w, spec, dtype):
     (matrix sums are compile-time constants, folded once per program)."""
     wr = jnp.asarray(w[0], dtype=dtype)
     wi = jnp.asarray(w[1], dtype=dtype)
-    f = lambda a, b: jnp.einsum(spec, a, b, precision=_PRECISION)
+    prec = matmul_precision()
+    f = lambda a, b: jnp.einsum(spec, a, b, precision=prec)
     if _cmatmul_algo() == "karatsuba":
         k1 = f(zr + zi, wr)
         k2 = f(zi, wr + wi)

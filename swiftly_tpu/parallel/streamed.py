@@ -490,10 +490,11 @@ def _facet_pass_sampled_fn(core, real_facets=False):
             a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
             A_re = (a_cos * fb[None, :]).astype(dt)
             A_im = (a_sin * fb[None, :]).astype(dt)
-            from ..ops.planar_backend import _PRECISION
+            from ..ops.planar_backend import matmul_precision
 
+            prec = matmul_precision()
             f = lambda a, b: jnp.einsum(
-                "rj,fjc->frc", a, b, precision=_PRECISION
+                "rj,fjc->frc", a, b, precision=prec
             )
             out_re = f(A_re, Fr)
             out_im = f(A_im, Fr)
@@ -525,10 +526,11 @@ def _facet_pass_sampled_fn(core, real_facets=False):
             a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
             A_re = (a_cos * fb[None, :]).astype(dt)
             A_im = (a_sin * fb[None, :]).astype(dt)
-            from ..ops.planar_backend import _PRECISION
+            from ..ops.planar_backend import matmul_precision
 
+            prec = matmul_precision()
             f = lambda a, b: jnp.einsum(
-                "rj,fjc->frc", a, b, precision=_PRECISION
+                "rj,fjc->frc", a, b, precision=prec
             )
             out_re = f(A_re, Fr) - f(A_im, Fi)
             out_im = f(A_re, Fi) + f(A_im, Fr)
@@ -671,10 +673,11 @@ def _bwd_sampled_fold_fn(core):
             Rr, Ri = rows[..., 0], rows[..., 1]
             Rr2 = Rr * p_cos + Ri * p_sin
             Ri2 = Ri * p_cos - Rr * p_sin
-            from ..ops.planar_backend import _PRECISION
+            from ..ops.planar_backend import matmul_precision
 
+            prec = matmul_precision()
             f = lambda a, b: jnp.einsum(
-                "ri,frj->fij", a, b, precision=_PRECISION
+                "ri,frj->fij", a, b, precision=prec
             )
             B = _fold_row_block(F, yB, np.dtype(dt).itemsize)
             n_blk = -(-yB // B)
@@ -1318,46 +1321,34 @@ class StreamedForward:
         )
         e0 = (offs0 - yB // 2).astype(np.int32)
 
-        def host_slab(s0):
-            idx = range(s0, s0 + Fg)
-            if self._facets_real:
-                zero = np.zeros((yB, yB), dtype=_np_dtype(core))
-                return (
-                    np.stack(
-                        [
-                            self._facet_data[i]
-                            if i < base.stack.n_real
-                            else zero
-                            for i in idx
-                        ]
-                    ),
-                )
-            if _planar(core):
-                zero = np.zeros((yB, yB), dtype=_np_dtype(core))
-                return tuple(
-                    np.ascontiguousarray(
-                        np.stack(
-                            [
-                                self._facet_data[i][..., p]
-                                if i < base.stack.n_real
-                                else zero
-                                for i in idx
-                            ]
-                        )
-                    )
-                    for p in (0, 1)
-                )
-            zero = np.zeros((yB, yB), dtype=_np_dtype(core))
-            return (
-                np.stack(
-                    [
-                        np.asarray(self._facet_data[i])
-                        if i < base.stack.n_real
-                        else zero
-                        for i in idx
-                    ]
-                ),
-            )
+        # Double-buffered host staging: building a fresh np.stack per
+        # slab grows host RSS by one slab per dispatch at hour scale
+        # (slab-sized arenas are retained, and async h2d can pin
+        # buffers) — fatal at 64k where a slab is 2 GB and a pass uploads
+        # ~70 of them. Two persistent buffers alternate instead; reuse is
+        # safe because slab i-2's checksum was pulled (its transfer AND
+        # compute finished) before buffer i%2 is overwritten.
+        n_planes = 2 if (_planar(core) and not self._facets_real) else 1
+        stage = [
+            [
+                np.empty((Fg, yB, yB), dtype=_np_dtype(core))
+                for _ in range(n_planes)
+            ]
+            for _ in range(2)
+        ]
+
+        def host_slab(s0, parity):
+            bufs = stage[parity]
+            for k in range(Fg):
+                i = s0 + k
+                for pi, buf in enumerate(bufs):
+                    if i >= base.stack.n_real:
+                        buf[k] = 0
+                    elif n_planes == 2:
+                        buf[k] = self._facet_data[i][..., pi]
+                    else:
+                        buf[k] = self._facet_data[i]
+            return tuple(bufs)
 
         samfn = _facet_pass_sampled_j(core, self._facets_real)
         stepfn = _column_group_step_j(core, subgrid_size, chunk)
@@ -1367,6 +1358,7 @@ class StreamedForward:
         # slab i-2's column step (8-byte checksum pull — block_until_ready
         # is not completion on tunnel runtimes), bounding live slabs to 2.
         pending = collections.deque()
+        n_slab_dispatch = 0  # continuous across groups: staging parity
         t_start = time.time()
         logger.info(
             "grouped stream: %d columns in groups of %d (chunk %d), "
@@ -1403,8 +1395,17 @@ class StreamedForward:
                     np.asarray(pending.popleft())
                 # drop the previous slab BEFORE uploading the next: at
                 # depth 1 both must never be live together
+                # parity from a CONTINUOUS dispatch counter, not the
+                # per-group slab index: with odd slabs-per-group a
+                # group-local parity would reuse the buffer of the
+                # previous group's final slab before its checksum (h2d +
+                # compute completion) was pulled
                 slab_dev = None  # noqa: F841 - releases device buffers
-                slab_dev = tuple(base._place(a) for a in host_slab(s0))
+                slab_dev = tuple(
+                    base._place(a)
+                    for a in host_slab(s0, n_slab_dispatch % 2)
+                )
+                n_slab_dispatch += 1
                 buf = samfn(
                     *slab_dev,
                     jnp.asarray(e0[s0 : s0 + Fg]),

@@ -183,3 +183,42 @@ def test_batched_backward_matches_per_subgrid():
     np.testing.assert_allclose(
         np.asarray(facets_a), np.asarray(facets_b), atol=1e-12
     )
+
+
+def test_flight_queue_checksum_fallback(monkeypatch):
+    """With SWIFTLY_QUEUE_CHECKSUM=1 the queue bounds in-flight work by
+    genuine element pulls even when block_until_ready lies (returns
+    before completion, as on tunnel-attached TPU runtimes)."""
+    from swiftly_tpu.api import FlightQueue
+
+    class LazyArray:
+        def __init__(self, log, i):
+            self.log, self.i = log, i
+            self.ndim = 2
+
+        def block_until_ready(self):
+            return self  # lies: returns without completing anything
+
+        def __getitem__(self, idx):
+            self.log.append(self.i)  # a pull genuinely completes it
+            return 0.0
+
+        def is_deleted(self):
+            return False
+
+    # default mode: the lying block_until_ready makes the depth bound
+    # advisory — nothing is actually completed (the documented caveat)
+    log = []
+    q = FlightQueue(2)
+    for a in [LazyArray(log, i) for i in range(5)]:
+        q.admit(a)
+    assert log == []
+
+    monkeypatch.setenv("SWIFTLY_QUEUE_CHECKSUM", "1")
+    log = []
+    q = FlightQueue(2)
+    for a in [LazyArray(log, i) for i in range(5)]:
+        q.admit(a)
+    assert log == [0, 1, 2]  # oldest items really pulled at the bound
+    q.drain()
+    assert log == [0, 1, 2, 3, 4]
