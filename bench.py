@@ -66,19 +66,29 @@ def _build(backend, params, dtype=None, streamed=False):
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
-        # lazy sparse real-plane facet construction: point-source facets
-        # are zeros plus a few mask-scaled pixels, so build the f32 real
-        # planes directly (== make_facet(...).real, pinned by tests) —
-        # the dense complex build costs ~minutes of host time per 64k
-        # facet and 4x the RAM
-        from swiftly_tpu import make_real_facet
+        # sparse facet descriptors: point-source facets are zeros plus a
+        # few mask-scaled pixels, so hand the streamed executors the
+        # pixels (densify() == make_facet(...).real, pinned by tests) —
+        # the dense planes are then SYNTHESISED on device, so facet-slab
+        # streaming uploads kilobytes per column group instead of the
+        # multi-GB stack (decisive through this tunnel's h2d path).
+        # BENCH_DENSE_FACETS=1 restores the dense host planes to measure
+        # the upload-bound path.
+        from swiftly_tpu import make_real_facet, make_sparse_facet
 
         rdt = np.float32 if dtype is None else np.dtype(dtype)
-        facet_tasks = [
-            (fc, (lambda fc=fc: make_real_facet(
-                config.image_size, fc, sources, dtype=rdt)))
-            for fc in facet_configs
-        ]
+        if os.environ.get("BENCH_DENSE_FACETS"):
+            facet_tasks = [
+                (fc, (lambda fc=fc: make_real_facet(
+                    config.image_size, fc, sources, dtype=rdt)))
+                for fc in facet_configs
+            ]
+        else:
+            facet_tasks = [
+                (fc, make_sparse_facet(
+                    config.image_size, fc, sources, dtype=rdt))
+                for fc in facet_configs
+            ]
         col_group = int(os.environ.get("BENCH_COL_GROUP", "0")) or None
         facet_group = int(os.environ.get("BENCH_FACET_GROUP", "0")) or None
         t0 = time.time()
@@ -498,6 +508,26 @@ def run_one(config_name, mode):
                 rms2 = jnp.mean(
                     res_re * res_re + res_im * res_im, axis=(1, 2)
                 )
+            elif getattr(fwd, "_facets_sparse", False):
+                # grouped sparse forward: synthesise each reference
+                # plane on device (no multi-GB re-upload). Pull each
+                # iteration's scalar before dispatching the next — the
+                # synthesised [yB, yB] planes would otherwise all go
+                # live at once (async dispatch; block_until_ready is
+                # not completion on this runtime).
+                rms2s = []
+                for i in range(n_real):
+                    ref = fwd.synth_facet_device(i)
+                    res_re = facets_dev[i, :, :, 0] - ref
+                    res_im = facets_dev[i, :, :, 1]
+                    rms2s.append(
+                        float(
+                            np.asarray(
+                                jnp.mean(res_re * res_re + res_im * res_im)
+                            )
+                        )
+                    )
+                rms2 = jnp.asarray(rms2s)
             else:
                 # re-upload per-facet references (grouped forward or
                 # complex facets: no resident copy to compare against)

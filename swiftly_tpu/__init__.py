@@ -23,6 +23,7 @@ from .api import (
     make_real_facet,
     make_full_facet_cover,
     make_full_subgrid_cover,
+    make_sparse_facet,
     make_sparse_facet_cover,
     make_subgrid,
     sparse_fov_cover_offsets,
@@ -55,6 +56,7 @@ __all__ = [
     "make_facet_from_sources",
     "make_full_facet_cover",
     "make_full_subgrid_cover",
+    "make_sparse_facet",
     "make_sparse_facet_cover",
     "make_subgrid",
     "make_subgrid_from_sources",

@@ -97,6 +97,26 @@ def make_real_facet(image_size, facet_config, sources, dtype=None):
     )
 
 
+def make_sparse_facet(image_size, facet_config, sources, dtype=None):
+    """`make_facet` as a `SparseRealFacet` descriptor (coords + values).
+
+    The input path for streamed executors at 64k+ scale: the facet
+    plane is synthesised ON DEVICE from these few pixels, so facet-slab
+    streaming re-uploads kilobytes per column group instead of the
+    multi-GB dense stack. `densify()` == `make_facet(...).real`."""
+    from .ops.oracle import make_sparse_real_facet_from_sources
+
+    kwargs = {} if dtype is None else {"dtype": dtype}
+    return make_sparse_real_facet_from_sources(
+        sources,
+        image_size,
+        facet_config.size,
+        [facet_config.off0, facet_config.off1],
+        [facet_config.mask0, facet_config.mask1],
+        **kwargs,
+    )
+
+
 def make_subgrid(image_size, sg_config, sources):
     """Build a subgrid's data by direct DFT (test/demo input)."""
     return make_subgrid_from_sources(

@@ -790,6 +790,27 @@ def _bwd_sampled_fold_sharded(core, mesh):
     )
 
 
+# -- device-side sparse facet synthesis -------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _synth_slab_j(core, Fg, yB):
+    """Scatter (facet, row, col, val) pixels into a zeroed real slab
+    [Fg, yB, yB] — the device-side synthesis of point-source-model
+    facets (`ops.oracle.SparseRealFacet`). Uploading coordinates instead
+    of planes turns facet-slab streaming from h2d-bound (2 GB per 64k
+    slab, once per column group) into compute-bound."""
+    import jax.numpy as jnp
+
+    dt = _np_dtype(core)
+
+    def fn(f, r, c, v):
+        z = jnp.zeros((Fg, yB, yB), dtype=dt)
+        return z.at[f, r, c].add(v)
+
+    return _jit()(fn)
+
+
 # -- facet-group forward column step ----------------------------------------
 #
 # At N >= 65536 the facet stack exceeds HBM (36.5 GB planar at 64k), so
@@ -975,9 +996,26 @@ class StreamedForward:
         # data may be a CALLABLE returning the facet (lazy construction:
         # at 64k one complex128 facet is 8 GB — materialising all of them
         # before conversion would double the host footprint).
-        store, real_flags = [], []
+        store, real_flags, sparse_flags = [], [], []
+        from ..ops.oracle import SparseRealFacet
+
+        sparse_ok = (
+            _planar(core)
+            and self._base.residency == "device"
+            and self._base.mesh is None
+        )
         for _, d in facet_tasks:
             raw = d() if callable(d) else d
+            if isinstance(raw, SparseRealFacet):
+                # keep sparse where the device-synthesis paths can use
+                # it (planar single-device sampled executors); densify
+                # for everything else
+                if sparse_ok:
+                    store.append(raw)
+                    real_flags.append(True)
+                    sparse_flags.append(True)
+                    continue
+                raw = raw.densify(_np_dtype(core))
             plane = _real_plane_or_none(core, raw)
             if plane is not None:
                 store.append(plane)
@@ -985,7 +1023,15 @@ class StreamedForward:
             else:
                 store.append(_to_host_layout(core, raw))
                 real_flags.append(False)
+            sparse_flags.append(False)
             del raw
+        # all-or-nothing: mixed sparse/dense stacks densify the sparse
+        # entries (the synthesis programs scatter the WHOLE slab/stack)
+        self._facets_sparse = bool(sparse_flags) and all(sparse_flags)
+        if not self._facets_sparse and any(sparse_flags):
+            for i, (s, is_sp) in enumerate(zip(store, sparse_flags)):
+                if is_sp:
+                    store[i] = s.densify(_np_dtype(core))
         self._facets_real = all(real_flags)
         if not self._facets_real and any(real_flags):
             # mixed: re-expand the real planes to planar pairs
@@ -995,6 +1041,7 @@ class StreamedForward:
                     pair[..., 0] = s
                     store[i] = pair
         self._facet_data = store
+        self._sparse_pad = None  # fixed per-facet pixel pad (one compile)
         self.col_group = col_group
         # facet_group: max facets device-resident at once (sampled path).
         # None = auto (all resident if the stack fits the HBM budget,
@@ -1008,6 +1055,45 @@ class StreamedForward:
         # (e.g. an uploaded oracle-sample stack); subtracted from the HBM
         # budget the auto-sizers see
         self.hbm_headroom = 0
+
+    # -- sparse synthesis --------------------------------------------------
+
+    def _sparse_pixels(self, i0, i1):
+        """Concatenated (facet, row, col, val) pixel arrays for facets
+        [i0, i1), facet index relative to i0, zero-padded to a fixed
+        per-facet maximum so every slab shares ONE compiled scatter
+        program (padding scatters value 0 at (0,0,0) — exact)."""
+        n_real = self._base.stack.n_real
+        if self._sparse_pad is None:
+            self._sparse_pad = max(
+                [d.n_pixels for d in self._facet_data] + [1]
+            )
+        width = i1 - i0
+        pad_to = self._sparse_pad * width
+        f = np.zeros(pad_to, np.int32)
+        r = np.zeros(pad_to, np.int32)
+        c = np.zeros(pad_to, np.int32)
+        v = np.zeros(pad_to, _np_dtype(self.core))
+        k = 0
+        for j, i in enumerate(range(i0, min(i1, n_real))):
+            sp = self._facet_data[i]
+            n = sp.n_pixels
+            f[k : k + n] = j
+            r[k : k + n] = sp.rows
+            c[k : k + n] = sp.cols
+            v[k : k + n] = sp.vals
+            k += n
+        return f, r, c, v
+
+    def synth_facet_device(self, i):
+        """Facet i's dense real plane [yB, yB], synthesised on device
+        (sparse mode only) — e.g. the round-trip reference for on-device
+        RMS checks without a multi-GB upload."""
+        if not self._facets_sparse:
+            raise ValueError("synth_facet_device requires sparse facets")
+        yB = self._base.stack.size
+        fn = _synth_slab_j(self.core, 1, yB)
+        return fn(*self._sparse_pixels(i, i + 1))[0]
 
     # -- facet pass --------------------------------------------------------
 
@@ -1150,7 +1236,14 @@ class StreamedForward:
         yB = base.stack.size
         n_pad = base.stack.n_total - base.stack.n_real
         if self._dev_facets is None:
-            if self._facets_real:
+            if self._facets_sparse:
+                # synthesise the resident stack on device: kilobytes of
+                # coordinates uploaded instead of the multi-GB planes
+                fn = _synth_slab_j(core, base.stack.n_total, yB)
+                self._dev_facets = (
+                    fn(*self._sparse_pixels(0, base.stack.n_total)),
+                )
+            elif self._facets_real:
                 host = np.ascontiguousarray(
                     np.stack(
                         self._facet_data
@@ -1310,6 +1403,9 @@ class StreamedForward:
         self.last_plan = {
             "mode": "grouped", "col_group": G, "facet_group": Fg,
             "n_slabs": n_slabs, "slab_depth": depth,
+            "facet_source": (
+                "device-synth-sparse" if self._facets_sparse else "host"
+            ),
         }
 
         # per-slab facet metadata, padded with zero facets to F_pad
@@ -1329,13 +1425,17 @@ class StreamedForward:
         # safe because slab i-2's checksum was pulled (its transfer AND
         # compute finished) before buffer i%2 is overwritten.
         n_planes = 2 if (_planar(core) and not self._facets_real) else 1
-        stage = [
-            [
-                np.empty((Fg, yB, yB), dtype=_np_dtype(core))
-                for _ in range(n_planes)
+        stage = (
+            None
+            if self._facets_sparse  # synthesised on device: no staging
+            else [
+                [
+                    np.empty((Fg, yB, yB), dtype=_np_dtype(core))
+                    for _ in range(n_planes)
+                ]
+                for _ in range(2)
             ]
-            for _ in range(2)
-        ]
+        )
 
         def host_slab(s0, parity):
             bufs = stage[parity]
@@ -1352,6 +1452,9 @@ class StreamedForward:
 
         samfn = _facet_pass_sampled_j(core, self._facets_real)
         stepfn = _column_group_step_j(core, subgrid_size, chunk)
+        synthfn = (
+            _synth_slab_j(core, Fg, yB) if self._facets_sparse else None
+        )
         tail = _tail(core)
         xA = subgrid_size
         # depth-2 completion pipeline: before uploading slab i, wait for
@@ -1401,10 +1504,15 @@ class StreamedForward:
                 # previous group's final slab before its checksum (h2d +
                 # compute completion) was pulled
                 slab_dev = None  # noqa: F841 - releases device buffers
-                slab_dev = tuple(
-                    base._place(a)
-                    for a in host_slab(s0, n_slab_dispatch % 2)
-                )
+                if synthfn is not None:
+                    slab_dev = (
+                        synthfn(*self._sparse_pixels(s0, s0 + Fg)),
+                    )
+                else:
+                    slab_dev = tuple(
+                        base._place(a)
+                        for a in host_slab(s0, n_slab_dispatch % 2)
+                    )
                 n_slab_dispatch += 1
                 buf = samfn(
                     *slab_dev,

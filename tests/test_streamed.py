@@ -500,3 +500,83 @@ def test_grouped_budget_accounting():
         for b in (1e9, 4e9, 16e9, 64e9)
     ]
     assert gs == sorted(gs)
+
+
+def test_sparse_facets_match_dense():
+    """Device-synthesised sparse facets == dense host facets, for both
+    the resident sampled path and facet-slab streaming, and for the
+    sampled round trip. Also pins densify() == make_facet(...).real."""
+    from swiftly_tpu import make_sparse_facet
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    sparse_tasks = [
+        (fc, make_sparse_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    for (fc, dense), (_, sp) in zip(facet_tasks, sparse_tasks):
+        np.testing.assert_allclose(
+            sp.densify(np.float64), np.asarray(dense).real, atol=1e-12
+        )
+
+    ref = StreamedForward(
+        config, facet_tasks, residency="device"
+    ).all_subgrids(subgrid_configs)
+    fwd_sp = StreamedForward(config, sparse_tasks, residency="device")
+    out = fwd_sp.all_subgrids(subgrid_configs)
+    assert fwd_sp._facets_sparse
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+    fwd_slab = StreamedForward(
+        config, sparse_tasks, residency="device", facet_group=2
+    )
+    out_slab = fwd_slab.all_subgrids(subgrid_configs)
+    assert (fwd_slab.last_plan or {}).get("facet_source") == (
+        "device-synth-sparse"
+    )
+    np.testing.assert_allclose(out_slab, ref, atol=1e-10)
+
+    # synth_facet_device returns the exact dense plane
+    plane = np.asarray(fwd_sp.synth_facet_device(0))
+    np.testing.assert_allclose(
+        plane, sparse_tasks[0][1].densify(plane.dtype), atol=0
+    )
+
+
+def test_sparse_facets_densify_on_host_residency():
+    """Sparse descriptors still work where synthesis is unsupported
+    (host residency): they densify transparently."""
+    from swiftly_tpu import make_sparse_facet
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    sparse_tasks = [
+        (fc, make_sparse_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    ref = StreamedForward(
+        config, facet_tasks, residency="host"
+    ).all_subgrids(subgrid_configs)
+    fwd = StreamedForward(config, sparse_tasks, residency="host")
+    assert not fwd._facets_sparse
+    out = fwd.all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_mixed_sparse_dense_facets_densify():
+    """A stack mixing SparseRealFacet and dense facets densifies the
+    sparse entries and matches the all-dense result."""
+    from swiftly_tpu import make_sparse_facet
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    mixed = [
+        (fc, make_sparse_facet(config.image_size, fc, SOURCES))
+        if i % 2 == 0
+        else (fc, data)
+        for i, (fc, data) in enumerate(facet_tasks)
+    ]
+    ref = StreamedForward(
+        config, facet_tasks, residency="device"
+    ).all_subgrids(subgrid_configs)
+    fwd = StreamedForward(config, mixed, residency="device")
+    assert not fwd._facets_sparse  # mixed -> densified
+    out = fwd.all_subgrids(subgrid_configs)
+    np.testing.assert_allclose(out, ref, atol=1e-10)
