@@ -471,13 +471,16 @@ def run_one(config_name, mode):
         acc_bytes = F_total * yB * yB * per_el
 
         def _set_headroom():
-            rows_bytes = (
-                fold_group[0] * F_total * core.xM_yN_size * yB * per_el
+            row_bytes = F_total * core.xM_yN_size * yB * per_el
+            # accumulator + live column rows (fold_group pending + 2 in
+            # flight, bounded by the backward's rows checksum pipeline)
+            # + the fold's phase-rotated copies and bounded row-block
+            # transients
+            fwd.hbm_headroom = int(
+                acc_bytes
+                + (2 * fold_group[0] + 2) * row_bytes
+                + 1.2e9  # fold row-blocks + donation-copy slack
             )
-            # accumulator + ~3x the fold-group row set (pending rows,
-            # their concatenation, and the phase-rotated copies inside
-            # the fold) + the fold's bounded row-block transients
-            fwd.hbm_headroom = int(acc_bytes + 3 * rows_bytes + 0.7e9)
 
         _set_headroom()
 

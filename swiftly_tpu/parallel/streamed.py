@@ -767,7 +767,12 @@ def _bwd_sampled_fold_j(core):
 @functools.lru_cache(maxsize=None)
 def _sampled_finish_j(core):
     """Apply the axis-0 facet masks to the sampled accumulator (the Fb
-    weighting and spectral extraction already happened in the fold)."""
+    weighting and spectral extraction already happened in the fold).
+
+    The accumulator is DONATED: it is the size of the whole facet stack
+    (9.8 GiB at 32k) and the caller never reuses it — an undonated
+    finish materialises a second stack next to it, which is exactly what
+    OOM'd the 32k round trip at the finish step."""
 
     def fn(acc, masks0):
         m = masks0[:, :, None]
@@ -775,7 +780,7 @@ def _sampled_finish_j(core):
             m = m[..., None]
         return acc * m
 
-    return _jit()(fn)
+    return _jit(donate=(0,))(fn)
 
 
 @functools.lru_cache(maxsize=None)
@@ -1634,8 +1639,14 @@ def grouped_col_group_for_budget(
     chunk_b = (
         chunk * S * xM * xM + chunk * facet_group * m * core.yN_size
     ) * dsize
+    # 4x the group buffer: the sampled pass materialises out_re/out_im
+    # and their stacked pair next to the [Fg, G*m, yB] buffer and its
+    # in-step transpose. 3x the finished accumulator row: the
+    # accumulator itself plus the yielded per-column slices a consumer
+    # holds while the next group is already dispatching (both
+    # unmodelled transients behind BENCH_r04 32k OOMs).
     per_G = (
-        2 * facet_group * m * yB + S * xA * xA
+        4 * facet_group * m * yB + 3 * S * xA * xA
     ) * dsize
     reserve = 0.6e9
     headroom = budget - slab_b - chunk_b - reserve
@@ -1744,6 +1755,12 @@ class StreamedBackward:
         import collections
 
         self._fold_inflight = collections.deque()
+        # ("sampled") column-pass completion pipeline: bounds live
+        # NAF_BMNAF row buffers ([F, m, yB, 2], ~208 MB each at 32k) to
+        # ~2 + fold_group — without it a caller feeding a whole column
+        # group back-to-back keeps every column's rows live at once
+        # (the BENCH_r04 32k roundtrip OOM ledger gap).
+        self._rows_inflight = collections.deque()
         self._finished = False
 
     def add_subgrids(self, tasks):
@@ -1790,6 +1807,11 @@ class StreamedBackward:
             colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
         else:
             colfn = _column_pass_bwd_j(core, yB)
+        if base.residency == "sampled":
+            # genuine completion pull of the column before last (8-byte
+            # host round trip) before dispatching another column pass
+            while len(self._rows_inflight) >= 2:
+                np.asarray(self._rows_inflight.popleft())
         rows = colfn(
             subgrids,
             sg_offs,
@@ -1799,6 +1821,7 @@ class StreamedBackward:
         )  # [F, m, yB] (facet-sharded on a mesh)
         key = int(off0)
         if base.residency == "sampled":
+            self._rows_inflight.append(jnp.sum(rows[:, 0]))
             self._pending_rows.append((key, rows))
             if len(self._pending_rows) >= self._fold_group:
                 self._flush_folds()
@@ -1875,7 +1898,8 @@ class StreamedBackward:
         if self._acc is None:
             raise RuntimeError("No subgrids were added")
         fn = _sampled_finish_j(self.core)
-        out = fn(self._acc, self._base._masks0_dev)
+        acc, self._acc = self._acc, None  # donated to the finish program
+        out = fn(acc, self._base._masks0_dev)
         self._finished = True
         return out
 
