@@ -92,11 +92,13 @@ def plan_only(args):
     print(f"  real-plane facet stack: {real_bytes / 2**30:.1f} GiB "
           f"(host); single-chip plan: column groups of G={G}, "
           f"{sweeps} facet-stack sweeps")
-    print(f"  h2d volume {h2d / 2**30:.0f} GiB "
+    print(f"  dense-input h2d volume {h2d / 2**30:.0f} GiB "
           f"(~{h2d / 2**30 / args.h2d_gibs:.0f} s at "
-          f"{args.h2d_gibs} GiB/s), analytic {flops / 1e12:.0f} TFLOP "
+          f"{args.h2d_gibs} GiB/s; ~ZERO with sparse device-synthesised "
+          f"facets — SparseRealFacet uploads coordinates only), "
+          f"analytic {flops / 1e12:.0f} TFLOP "
           f"(~{flops / 1e12 / args.tflops:.0f} s at {args.tflops:.0f} "
-          f"TF/s measured)")
+          f"TF/s, the measured 64k streamed rate — BENCH_64k_streamed_r4)")
     host_ram = real_bytes / 2**30
     if host_ram > args.host_ram_gib:
         n_hosts = int(np.ceil(host_ram / (args.host_ram_gib * 0.7)))
@@ -123,8 +125,9 @@ def main():
                     "single-chip + multi-host sizing, incl. 128k")
     ap.add_argument("--h2d_gibs", type=float, default=0.85,
                     help="measured h2d bandwidth for --plan_only")
-    ap.add_argument("--tflops", type=float, default=13.0,
-                    help="measured sustained TF/s for --plan_only")
+    ap.add_argument("--tflops", type=float, default=14.28,
+                    help="measured sustained TF/s for --plan_only "
+                    "(default: the 64k streamed rate, BENCH_r04)")
     ap.add_argument("--host_ram_gib", type=float, default=125.0,
                     help="host RAM for the multi-host threshold")
     args = ap.parse_args()
