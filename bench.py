@@ -449,7 +449,6 @@ def run_one(config_name, mode):
         plan = fwd.last_plan or {}
         extra["facets_real"] = fwd._facets_real
         extra["plan"] = plan
-        finish_passes = plan.get("n_slabs", 1)
     elif mode == "roundtrip-streamed":
         import jax.numpy as jnp
 
@@ -575,7 +574,6 @@ def run_one(config_name, mode):
         extra["fold_group"] = fold_group[0]
         plan = fwd.last_plan or {}
         extra["plan"] = plan
-        finish_passes = plan.get("n_slabs", 1)
     elif mode == "roundtrip":
         from swiftly_tpu import backward_all, check_facet
 

@@ -195,7 +195,7 @@ def _facet_pass_fwd_sharded(core, mesh):
     )
 
 
-def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
+def _column_pass_fwd_fn(core, subgrid_size, axis_name=None, finish=True):
     """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
 
     The facet reduction is a lax.scan accumulating one [S, xM, xM]
@@ -205,10 +205,15 @@ def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     32k scale. With `axis_name`, F is the local facet shard and the
     reduction finishes with ONE psum over the accumulated partials —
     the streamed pipeline's only collective.
+
+    With ``finish=False`` the PRE-finish partials [S, xM, xM] are
+    returned (no masks consumed): the facet-slab path accumulates those
+    across slabs and finishes ONCE per column group — at 64k the
+    per-slab finish was 44% of all FLOPs.
     """
     p = core._p
 
-    def fn(NMBF, foffs0, foffs1, sg_offs, masks0, masks1):
+    def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
         def prep1(x, off1):
             return prepare_facet_math(p, core._Fb, core.yN_size, x, off1, 1)
 
@@ -234,6 +239,8 @@ def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
         )
         if axis_name is not None:
             partials = jax.lax.psum(partials, axis_name)
+        if not finish:
+            return partials
 
         def fin(summed, sg_off_pair, m0, m1):
             return finish_masked_subgrid(
@@ -831,18 +838,20 @@ def _synth_slab_j(core, Fg, yB):
 
 
 def _column_group_step_fn(core, subgrid_size, chunk):
-    """One facet slab's finished contribution, added into the group acc.
+    """One facet slab's PRE-FINISH contribution, added into the group acc.
 
-    acc [n_chunks, chunk, S, xA, xA(,2)]; buf [Fg, G*m, yB(,2)] is the
+    acc [n_chunks, chunk, S, xM, xM(,2)]; buf [Fg, G*m, yB(,2)] is the
     slab's sampled rows for the whole column group (G = n_chunks*chunk).
-    Columns are scanned `chunk` at a time to bound the [chunk, S, xM, xM]
-    transient while keeping a chunk*S batch for the small-matmul finish
-    stages.
+    Columns are scanned `chunk` at a time to bound the per-step
+    transient. The finish (iFFT/crop/masks) is NOT applied here: it
+    runs ONCE per group (`_column_group_finish_j`) after all slabs
+    accumulated — finishing per slab cost n_slabs-1 extra finish passes,
+    44% of all FLOPs at 64k.
     """
     m = core.xM_yN_size
-    colfn = _column_pass_fwd_fn(core, subgrid_size)
+    colfn = _column_pass_fwd_fn(core, subgrid_size, finish=False)
 
-    def fn(acc, buf, foffs0, foffs1, sg_offs_g, masks0_g, masks1_g):
+    def fn(acc, buf, foffs0, foffs1, sg_offs_g):
         Fg = buf.shape[0]
         n_chunks = acc.shape[0]
         G = n_chunks * acc.shape[1]
@@ -852,16 +861,14 @@ def _column_group_step_fn(core, subgrid_size, chunk):
         NMBF_c = NMBF_g.reshape((n_chunks, acc.shape[1]) + NMBF_g.shape[1:])
 
         def step(carry, xs):
-            c, nm, so, m0, m1 = xs
-            out = jax.vmap(colfn, in_axes=(0, None, None, 0, 0, 0))(
-                nm, foffs0, foffs1, so, m0, m1
-            )  # [chunk, S, xA, xA(,2)]
+            c, nm, so = xs
+            out = jax.vmap(colfn, in_axes=(0, None, None, 0))(
+                nm, foffs0, foffs1, so
+            )  # [chunk, S, xM, xM(,2)]
             return carry.at[c].add(out), None
 
         idx = jax.numpy.arange(n_chunks)
-        acc, _ = jax.lax.scan(
-            step, acc, (idx, NMBF_c, sg_offs_g, masks0_g, masks1_g)
-        )
+        acc, _ = jax.lax.scan(step, acc, (idx, NMBF_c, sg_offs_g))
         return acc
 
     return fn
@@ -870,6 +877,29 @@ def _column_group_step_fn(core, subgrid_size, chunk):
 @functools.lru_cache(maxsize=None)
 def _column_group_step_j(core, subgrid_size, chunk):
     return _jit(donate=(0,))(_column_group_step_fn(core, subgrid_size, chunk))
+
+
+def _column_group_finish_fn(core, subgrid_size):
+    """Finish a whole group's accumulated partials in one program:
+    [n_chunks, chunk, S, xM, xM(,2)] -> finished subgrids
+    [n_chunks, chunk, S, xA, xA(,2)] (crop iFFTs + masks)."""
+
+    def fn(acc, sg_offs_g, masks0_g, masks1_g):
+        def fin(summed, so, m0, m1):
+            return finish_masked_subgrid(
+                core, summed, so, subgrid_size, m0, m1
+            )
+
+        per_col = jax.vmap(fin)  # over S
+        per_chunk = jax.vmap(per_col)  # over chunk
+        return jax.vmap(per_chunk)(acc, sg_offs_g, masks0_g, masks1_g)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _column_group_finish_j(core, subgrid_size):
+    return _jit(donate=(0,))(_column_group_finish_fn(core, subgrid_size))
 
 
 
@@ -1402,8 +1432,17 @@ class StreamedForward:
                     base, budget, len(col_offs0), S, subgrid_size,
                     self._facets_real, Fg, chunk, slab_depth=depth,
                 )
+                # round G down as little as possible: the largest
+                # multiple of any chunk in (4, 3, 2) wins (G=7 -> 6 with
+                # chunk 3, not 4 with chunk 4 — fewer groups beats a
+                # bigger small-matmul batch)
+                if G > 1:
+                    G, chunk = max(
+                        (((G // c) * c, c) for c in (4, 3, 2)),
+                        key=lambda t: (t[0], t[1]),
+                    )
         chunk = min(chunk, G)
-        G = (G // chunk) * chunk
+        G = max(1, (G // chunk) * chunk)
         n_chunks = G // chunk
         self.last_plan = {
             "mode": "grouped", "col_group": G, "facet_group": Fg,
@@ -1457,11 +1496,12 @@ class StreamedForward:
 
         samfn = _facet_pass_sampled_j(core, self._facets_real)
         stepfn = _column_group_step_j(core, subgrid_size, chunk)
+        finfn = _column_group_finish_j(core, subgrid_size)
         synthfn = (
             _synth_slab_j(core, Fg, yB) if self._facets_sparse else None
         )
         tail = _tail(core)
-        xA = subgrid_size
+        xM = core.xM_size
         # depth-2 completion pipeline: before uploading slab i, wait for
         # slab i-2's column step (8-byte checksum pull — block_until_ready
         # is not completion on tunnel runtimes), bounding live slabs to 2.
@@ -1494,8 +1534,10 @@ class StreamedForward:
             so_c = _chunked(sg_offs_g)
             m0_c = _chunked(m0_g, rdt)
             m1_c = _chunked(m1_g, rdt)
+            # PRE-finish accumulator ([.., xM, xM], 1.31x the finished
+            # size): the finish runs once per group, not once per slab
             acc = jnp.zeros(
-                (n_chunks, chunk, S, xA, xA) + tail, dtype=_np_dtype(core)
+                (n_chunks, chunk, S, xM, xM) + tail, dtype=_np_dtype(core)
             )
             slab_dev = None
             for s0 in range(0, F_pad, Fg):
@@ -1530,8 +1572,6 @@ class StreamedForward:
                     jnp.asarray(offs0[s0 : s0 + Fg]),
                     jnp.asarray(offs1[s0 : s0 + Fg]),
                     so_c,
-                    m0_c,
-                    m1_c,
                 )
                 pending.append(jnp.sum(acc))
                 if logger.isEnabledFor(logging.INFO):
@@ -1542,10 +1582,16 @@ class StreamedForward:
                         s0 // Fg + 1, n_slabs,
                         time.time() - t_start, _rss_gib(),
                     )
+            # finish the whole group in one program (acc donated: the
+            # finished array replaces it; the runtime orders the finish
+            # after the pending slab steps on the same buffer — the
+            # depth-2 checksum pipeline keeps bounding live slabs)
+            finished = finfn(acc, so_c, m0_c, m1_c)
+            del acc
             for gi, off0 in enumerate(grp):
                 prog_items = groups[off0]
                 items = [it for it in prog_items if it[0] is not None]
-                yield items, acc[gi // chunk, gi % chunk]
+                yield items, finished[gi // chunk, gi % chunk]
 
     def _hbm_budget(self):
         """Per-device HBM budget in bytes (None = unlimited, e.g. CPU).
@@ -1641,12 +1687,12 @@ def grouped_col_group_for_budget(
     ) * dsize
     # 4x the group buffer: the sampled pass materialises out_re/out_im
     # and their stacked pair next to the [Fg, G*m, yB] buffer and its
-    # in-step transpose. 3x the finished accumulator row: the
-    # accumulator itself plus the yielded per-column slices a consumer
-    # holds while the next group is already dispatching (both
-    # unmodelled transients behind BENCH_r04 32k OOMs).
+    # in-step transpose. The accumulator is pre-finish [S, xM, xM];
+    # the finished group array plus the yielded per-column slices a
+    # consumer holds while the next group dispatches add 3x [S, xA, xA]
+    # (unmodelled transients behind BENCH_r04 32k OOMs).
     per_G = (
-        4 * facet_group * m * yB + 3 * S * xA * xA
+        4 * facet_group * m * yB + S * xM * xM + 3 * S * xA * xA
     ) * dsize
     reserve = 0.6e9
     headroom = budget - slab_b - chunk_b - reserve
@@ -1662,9 +1708,11 @@ def grouped_col_group_for_budget(
             budget / 2**30, chunk,
             (slab_b + chunk_b + reserve) / 2**30, per_G / 2**30,
         )
+    # no chunk rounding here: the caller picks the (G, chunk) pair —
+    # rounding G down to a chunk multiple at this level cost 64k a
+    # third of its group size
     G = int(headroom // per_G)
-    G = max(chunk, (G // chunk) * chunk)
-    return min(G, ((n_cols + chunk - 1) // chunk) * chunk)
+    return max(1, min(G, ((n_cols + chunk - 1) // chunk) * chunk))
 
 
 def col_group_for_budget(base, budget, n_cols, real=False):
