@@ -879,6 +879,30 @@ def _column_group_step_j(core, subgrid_size, chunk):
     return _jit(donate=(0,))(_column_group_step_fn(core, subgrid_size, chunk))
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB):
+    """ONE program per facet slab: sparse synthesis -> sampled-DFT pass
+    -> column-group step, with the group accumulator donated through.
+
+    The tunnel runtime pays ~0.1 s of latency per dispatch chain
+    (measured, scripts/roofline.py); the unfused slab path cost three
+    dispatches per slab. Fusing also lets XLA schedule the scatter and
+    einsum together and drops the intermediate slab buffer's round trip
+    through HBM allocation."""
+    import jax.numpy as jnp
+
+    sam = _facet_pass_sampled_fn(core, real_facets=True)
+    step = _column_group_step_fn(core, subgrid_size, chunk)
+    dt = _np_dtype(core)
+
+    def fn(acc, f, r, c, v, e0, krows, foffs0, foffs1, so_c):
+        slab = jnp.zeros((Fg, yB, yB), dtype=dt).at[f, r, c].add(v)
+        buf = sam(slab, e0, krows)
+        return step(acc, buf, foffs0, foffs1, so_c)
+
+    return _jit(donate=(0,))(fn)
+
+
 def _column_group_finish_fn(core, subgrid_size):
     """Finish a whole group's accumulated partials in one program:
     [n_chunks, chunk, S, xM, xM(,2)] -> finished subgrids
@@ -1497,8 +1521,10 @@ class StreamedForward:
         samfn = _facet_pass_sampled_j(core, self._facets_real)
         stepfn = _column_group_step_j(core, subgrid_size, chunk)
         finfn = _column_group_finish_j(core, subgrid_size)
-        synthfn = (
-            _synth_slab_j(core, Fg, yB) if self._facets_sparse else None
+        fusedfn = (
+            _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB)
+            if self._facets_sparse
+            else None
         )
         tail = _tail(core)
         xM = core.xM_size
@@ -1551,28 +1577,35 @@ class StreamedForward:
                 # previous group's final slab before its checksum (h2d +
                 # compute completion) was pulled
                 slab_dev = None  # noqa: F841 - releases device buffers
-                if synthfn is not None:
-                    slab_dev = (
-                        synthfn(*self._sparse_pixels(s0, s0 + Fg)),
+                if fusedfn is not None:
+                    # one dispatch: synth + sampled pass + column step
+                    acc = fusedfn(
+                        acc,
+                        *self._sparse_pixels(s0, s0 + Fg),
+                        jnp.asarray(e0[s0 : s0 + Fg]),
+                        krows,
+                        jnp.asarray(offs0[s0 : s0 + Fg]),
+                        jnp.asarray(offs1[s0 : s0 + Fg]),
+                        so_c,
                     )
                 else:
                     slab_dev = tuple(
                         base._place(a)
                         for a in host_slab(s0, n_slab_dispatch % 2)
                     )
+                    buf = samfn(
+                        *slab_dev,
+                        jnp.asarray(e0[s0 : s0 + Fg]),
+                        krows,
+                    )
+                    acc = stepfn(
+                        acc,
+                        buf,
+                        jnp.asarray(offs0[s0 : s0 + Fg]),
+                        jnp.asarray(offs1[s0 : s0 + Fg]),
+                        so_c,
+                    )
                 n_slab_dispatch += 1
-                buf = samfn(
-                    *slab_dev,
-                    jnp.asarray(e0[s0 : s0 + Fg]),
-                    krows,
-                )
-                acc = stepfn(
-                    acc,
-                    buf,
-                    jnp.asarray(offs0[s0 : s0 + Fg]),
-                    jnp.asarray(offs1[s0 : s0 + Fg]),
-                    so_c,
-                )
                 pending.append(jnp.sum(acc))
                 if logger.isEnabledFor(logging.INFO):
                     logger.info(

@@ -492,8 +492,9 @@ def test_grouped_budget_accounting():
     base = fwd._base
     # huge budget -> capped at the (chunk-rounded) column count
     assert grouped_col_group_for_budget(base, 1e15, 40, 5, 228, True, 1, 4) == 40
-    # tiny budget -> floor of one chunk
-    assert grouped_col_group_for_budget(base, 1.0, 40, 5, 228, True, 1, 4) == 4
+    # tiny budget -> floor of one column (the CALLER picks the
+    # (G, chunk) rounding since r4)
+    assert grouped_col_group_for_budget(base, 1.0, 40, 5, 228, True, 1, 4) == 1
     # monotone in budget
     gs = [
         grouped_col_group_for_budget(base, b, 10**6, 5, 228, True, 1, 4)
