@@ -828,13 +828,13 @@ def _synth_slab_j(core, Fg, yB):
 # At N >= 65536 the facet stack exceeds HBM (36.5 GB planar at 64k), so
 # the sampled-DFT path streams FACET GROUPS: columns are processed in
 # groups of G, and within a column group the facets arrive in slabs of
-# `facet_group`; each slab's finished contribution is ADDED into a
-# per-column-group output accumulator (every stage of the transform —
-# including the finish iFFT, crop and masks — is linear in the facets,
-# so accumulating finished subgrids across facet slabs is exact). The
-# repeated finish costs ~1% extra FLOPs and buys a [G,S,xA,xA] instead
-# of a [G,S,xM,xM] accumulator. Device residency: one facet slab + the
-# accumulator + one sampled group buffer — bounded regardless of N.
+# `facet_group`; each slab's PRE-FINISH contribution is ADDED into a
+# per-column-group [G, S, xM, xM] accumulator (every stage of the
+# transform is linear in the facets, so cross-slab accumulation is
+# exact), and the finish (iFFT/crop/masks) runs ONCE per column group —
+# finishing per slab cost n_slabs-1 extra finish passes, 44% of all
+# FLOPs at 64k. Device residency: one facet slab + the accumulator +
+# one sampled group buffer — bounded regardless of N.
 
 
 def _column_group_step_fn(core, subgrid_size, chunk):
