@@ -495,11 +495,14 @@ def run_one(config_name, mode):
                 config, facet_configs, residency="sampled",
                 fold_group=fold_group[0],
             )
-            for items, out in fwd.stream_columns(
-                subgrid_configs, device_arrays=True
+            # group feeding: one vmapped column pass + one fold per
+            # forward column group (per-column feeding pays the
+            # per-dispatch tunnel latency 2G+ times per group)
+            for per_col, group in fwd.stream_column_groups(
+                subgrid_configs
             ):
-                bwd.add_subgrid_stack(
-                    [sg for _, sg in items], out[: len(items)]
+                bwd.add_subgrid_group(
+                    [[sg for _, sg in col] for col in per_col], group
                 )
             facets_dev = bwd.finish_device()
             n_real = fwd.stack.n_real

@@ -347,6 +347,18 @@ def _column_pass_bwd_j(core, facet_size):
 
 
 @functools.lru_cache(maxsize=None)
+def _column_pass_bwd_group_j(core, facet_size):
+    """A whole column GROUP's backward column passes as one dispatch:
+    subgrids [G, S, xA, xA(,2)] -> rows [G, F, m, yB(,2)]. Per-dispatch
+    latency on tunnel runtimes makes per-column dispatch the dominant
+    cost of the backward leg (measured ~0.1 s per chain)."""
+    fn = _column_pass_bwd_fn(core, facet_size)
+    return _jit()(
+        jax.vmap(fn, in_axes=(0, 0, None, None, None))
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _column_pass_bwd_sharded(core, mesh, facet_size):
     """Facet-sharded backward column pass (subgrids replicated; the split
     and fold are shard-local, no collectives)."""
@@ -989,6 +1001,17 @@ class _StreamedBase:
         return np.zeros(shape, dtype=_np_dtype(self.core))
 
 
+def _whole_group_yield(groups, grp, G, arr):
+    """(per_col_items, group_array) for a whole-group yield: real items
+    per column, and the group array with the short final group's padded
+    (repeated-last-column) entries sliced off — folding those would
+    double-count."""
+    per_col = [
+        [it for it in groups[off0] if it[0] is not None] for off0 in grp
+    ]
+    return per_col, (arr if len(grp) == G else arr[: len(grp)])
+
+
 def _group_full_columns(subgrid_configs):
     """Group configs by off0, padding ragged columns to equal length.
 
@@ -1224,6 +1247,41 @@ class StreamedForward:
             jnp.asarray(np.stack([m[1] for m in ms]), rdt),
         )
 
+    def _sampled_generator(self, groups, size, whole_groups=False):
+        """Select the sampled-path generator (facets-resident vs
+        facet-slab-streamed) — the ONE place the facet_group heuristic
+        lives for both per-column and whole-group streaming."""
+        fg = self.facet_group
+        if fg is None and not self._facet_stack_fits():
+            fg = 1
+        if fg is not None and fg < self._base.stack.n_total:
+            return self._grouped_device_columns(
+                groups, size, fg, whole_groups=whole_groups
+            )
+        return self._device_columns(
+            groups, size, whole_groups=whole_groups
+        )
+
+    def stream_column_groups(self, subgrid_configs):
+        """Yield (per_col_items, group_subgrids) per COLUMN GROUP of the
+        sampled-DFT paths: `per_col_items` is a list (one entry per
+        column) of [(input_index, SubgridConfig), ...] and
+        `group_subgrids` the whole group's DEVICE array
+        [G, S, xA, xA(,2)]. For consumers that process groups in one
+        dispatch (e.g. `StreamedBackward.add_subgrid_group`) — slicing
+        per column and re-dispatching per column pays the tunnel's
+        per-dispatch latency G+ times over.
+        """
+        subgrid_configs = list(subgrid_configs)
+        groups = _group_full_columns(subgrid_configs)
+        size = subgrid_configs[0].size
+        if self._base.residency != "device":
+            raise ValueError(
+                "stream_column_groups is a sampled-path (residency="
+                "'device') API"
+            )
+        yield from self._sampled_generator(groups, size, whole_groups=True)
+
     def stream_columns(self, subgrid_configs, device_arrays=False):
         """Yield (col_items, subgrids) per column; one device program each.
 
@@ -1237,13 +1295,7 @@ class StreamedForward:
         groups = _group_full_columns(subgrid_configs)
         size = subgrid_configs[0].size
         if self._base.residency == "device":
-            fg = self.facet_group
-            if fg is None and not self._facet_stack_fits():
-                fg = 1
-            if fg is not None and fg < self._base.stack.n_total:
-                gen = self._grouped_device_columns(groups, size, fg)
-            else:
-                gen = self._device_columns(groups, size)
+            gen = self._sampled_generator(groups, size)
         else:
             if self._base.mesh is not None:
                 colfn = _column_pass_fwd_sharded(
@@ -1277,7 +1329,7 @@ class StreamedForward:
             NMBF = self._nmbf_column(self._col_index[int(off0)])
             yield items, self._column_program(colfn, NMBF, prog_items)
 
-    def _device_columns(self, groups, subgrid_size):
+    def _device_columns(self, groups, subgrid_size, whole_groups=False):
         """Facets-resident sampled-DFT pass in column groups.
 
         Facets upload ONCE and stay on device; each group of G columns'
@@ -1389,12 +1441,17 @@ class StreamedForward:
                 jnp.asarray(np.asarray(m1_g), rdt),
             )  # [G, S, xA, xA(,2)]
             prev_tail = jnp.sum(out_g)
+            if whole_groups:
+                yield _whole_group_yield(groups, grp, G, out_g)
+                continue
             for gi, off0 in enumerate(grp):
                 prog_items = groups[off0]
                 items = [it for it in prog_items if it[0] is not None]
                 yield items, out_g[gi]
 
-    def _grouped_device_columns(self, groups, subgrid_size, facet_group):
+    def _grouped_device_columns(
+        self, groups, subgrid_size, facet_group, whole_groups=False
+    ):
         """Sampled-DFT pass streaming FACET SLABS: stacks larger than HBM.
 
         Column groups of G are the outer loop; within one, facet slabs of
@@ -1621,6 +1678,10 @@ class StreamedForward:
             # depth-2 checksum pipeline keeps bounding live slabs)
             finished = finfn(acc, so_c, m0_c, m1_c)
             del acc
+            if whole_groups:
+                flat = finished.reshape((G,) + finished.shape[2:])
+                yield _whole_group_yield(groups, grp, G, flat)
+                continue
             for gi, off0 in enumerate(grp):
                 prog_items = groups[off0]
                 items = [it for it in prog_items if it[0] is not None]
@@ -1922,13 +1983,11 @@ class StreamedBackward:
             else:
                 self._naf[key] = np.array(rows)  # writable copy
 
-    def _flush_folds(self):
-        """("sampled") fold the pending columns' rows into the image-space
-        accumulator: one adjoint-sampled einsum over fold_group*m rows."""
+    def _fold_rows(self, offs, rows_cat):
+        """("sampled") one adjoint-sampled fold of concatenated column
+        rows [F, P*m, yB(,2)] into the image-space accumulator."""
         import jax.numpy as jnp
 
-        if not self._pending_rows:
-            return
         base = self._base
         core = base.core
         yB = base.stack.size
@@ -1945,15 +2004,7 @@ class StreamedBackward:
             e0 = self._e0_dev = base._place(
                 (np.asarray(base.stack.offs0) - yB // 2).astype(np.int32)
             )
-        offs = [o for o, _ in self._pending_rows]
         krows = jnp.asarray(sampled_row_indices(core, offs))
-        rows_cat = (
-            self._pending_rows[0][1]
-            if len(self._pending_rows) == 1
-            else jnp.concatenate(
-                [r for _, r in self._pending_rows], axis=1
-            )
-        )  # [F, P*m, yB(,2)]
         if base.mesh is not None:
             foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
         else:
@@ -1965,7 +2016,99 @@ class StreamedBackward:
         self._acc = foldfn(self._acc, rows_cat, e0, krows)
         # the checksum slice depends on the whole fold having executed
         self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
+
+    def _flush_folds(self):
+        """("sampled") fold the pending columns' rows into the image-space
+        accumulator: one adjoint-sampled einsum over fold_group*m rows."""
+        import jax.numpy as jnp
+
+        if not self._pending_rows:
+            return
+        offs = [o for o, _ in self._pending_rows]
+        rows_cat = (
+            self._pending_rows[0][1]
+            if len(self._pending_rows) == 1
+            else jnp.concatenate(
+                [r for _, r in self._pending_rows], axis=1
+            )
+        )  # [F, P*m, yB(,2)]
+        self._fold_rows(offs, rows_cat)
         self._pending_rows = []
+
+    def add_subgrid_group(self, col_sg_lists, subgrids_group):
+        """("sampled") fold a whole forward column GROUP in TWO
+        dispatches: one vmapped column pass over the group's stacked
+        subgrids and one adjoint fold over the G*m concatenated rows —
+        feeding the same group per column pays the tunnel's per-dispatch
+        latency 2G+ times (the dominant backward-leg cost, measured).
+
+        :param col_sg_lists: per-column lists of SubgridConfigs (one
+            shared off0 each). Columns may hold FEWER configs than the
+            group array's S rows — the trailing rows are the forward's
+            zero-mask padding, which is exactly zero and folds to zero
+            whatever offsets are assumed for it.
+        :param subgrids_group: device [G, S, xA, xA(,2)], e.g. one yield
+            of `StreamedForward.stream_column_groups`.
+        """
+        import jax.numpy as jnp
+
+        if self._finished:
+            raise RuntimeError("finish() was already called")
+        if self._base.residency != "sampled":
+            raise ValueError(
+                "add_subgrid_group requires residency='sampled'"
+            )
+        base = self._base
+        if base.mesh is not None:
+            # per-column sharded path (the group-batched column pass is
+            # single-device; on a mesh the latency it amortises is not
+            # the bottleneck anyway)
+            for gi, col in enumerate(col_sg_lists):
+                self.add_subgrid_stack(col, subgrids_group[gi][: len(col)])
+            return
+        core = base.core
+        yB = base.stack.size
+        S = subgrids_group.shape[1]
+        offs, sg_offs = [], []
+        for col in col_sg_lists:
+            off0s = {sg.off0 for sg in col}
+            if len(off0s) != 1:
+                raise ValueError(
+                    f"each group entry must be ONE column, got {off0s}"
+                )
+            off0 = off0s.pop()
+            offs.append(int(off0))
+            pairs = [(sg.off0, sg.off1) for sg in col]
+            pairs += [(off0, 0)] * (S - len(pairs))  # zero-pad rows
+            sg_offs.append(pairs)
+        # flush any pending per-column rows first so fold order follows
+        # feed order (accumulation is exact either way — linearity)
+        self._flush_folds()
+        colfn = _column_pass_bwd_group_j(core, yB)
+        sg_offs_np = np.asarray(sg_offs)
+        # batch cap = fold_group: an uncapped group's [G, F, m, yB] rows
+        # plus the fold's rotated copies would blow the headroom the
+        # forward's sizers were given (rows are ~208 MB per 32k column;
+        # bench.py's roundtrip headroom term (2*fold_group+2)*row_bytes
+        # covers this capped batch's live set, validated green at 32k)
+        cap = max(1, int(self._fold_group))
+        G = len(offs)
+        for j in range(0, G, cap):
+            while len(self._rows_inflight) >= 2:
+                np.asarray(self._rows_inflight.popleft())
+            rows = colfn(
+                jnp.asarray(subgrids_group[j : j + cap]),
+                jnp.asarray(sg_offs_np[j : j + cap]),
+                base._foffs0,
+                base._foffs1,
+                base._masks1_dev,
+            )  # [g, F, m, yB(,2)]
+            self._rows_inflight.append(jnp.sum(rows[:, :, 0]))
+            rows_cat = jnp.moveaxis(rows, 0, 1).reshape(
+                (rows.shape[1], rows.shape[0] * rows.shape[2])
+                + rows.shape[3:]
+            )  # [F, g*m, yB(,2)]
+            self._fold_rows(offs[j : j + cap], rows_cat)
 
     def finish_device(self):
         """("sampled") the finished facet stack [F_total, yB, yB(,2)] as a

@@ -581,3 +581,36 @@ def test_mixed_sparse_dense_facets_densify():
     assert not fwd._facets_sparse  # mixed -> densified
     out = fwd.all_subgrids(subgrid_configs)
     np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+@pytest.mark.parametrize("facet_group", [None, 2])
+def test_group_feeding_matches_per_column(facet_group):
+    """stream_column_groups + add_subgrid_group == per-column feeding,
+    for both resident and facet-slab forward paths."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+
+    fwd_a = StreamedForward(
+        config, facet_tasks, residency="device", facet_group=facet_group,
+        col_group=4,
+    )
+    bwd_a = StreamedBackward(config, facet_configs, residency="sampled")
+    for items, out in fwd_a.stream_columns(
+        subgrid_configs, device_arrays=True
+    ):
+        bwd_a.add_subgrid_stack([sg for _, sg in items], out[: len(items)])
+    ref = bwd_a.finish()
+
+    fwd_b = StreamedForward(
+        config, facet_tasks, residency="device", facet_group=facet_group,
+        col_group=4,
+    )
+    bwd_b = StreamedBackward(config, facet_configs, residency="sampled")
+    n_cols = 0
+    for per_col, group in fwd_b.stream_column_groups(subgrid_configs):
+        n_cols += len(per_col)
+        bwd_b.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    assert n_cols == len({sg.off0 for sg in subgrid_configs})
+    out = bwd_b.finish()
+    np.testing.assert_allclose(out, ref, atol=1e-10)
