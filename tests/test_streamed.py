@@ -614,3 +614,33 @@ def test_group_feeding_matches_per_column(facet_group):
     assert n_cols == len({sg.off0 for sg in subgrid_configs})
     out = bwd_b.finish()
     np.testing.assert_allclose(out, ref, atol=1e-10)
+
+
+def test_group_feeding_mesh_fallback():
+    """add_subgrid_group on a mesh falls back to per-column sharded
+    feeding and still reproduces the facets."""
+    from swiftly_tpu.parallel.mesh import make_facet_mesh
+
+    mesh = make_facet_mesh()
+    config = SwiftlyConfig(
+        backend="planar", mesh=mesh, dtype=np.float64, **TEST_PARAMS
+    )
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    for per_col, group in fwd.stream_column_groups(subgrid_configs):
+        bwd.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    facets = bwd.finish()
+    for i, fc in enumerate(facet_configs):
+        err = check_facet(
+            config.image_size, fc, config.core.as_complex(facets[i]),
+            SOURCES,
+        )
+        assert err < 3e-10
