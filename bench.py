@@ -285,8 +285,13 @@ def _cover_kwargs(facet_configs, subgrid_configs):
 
 
 def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed,
-                 real_facets=False, finish_passes=1):
-    """Analytic FLOP count -> tflops / mfu_pct fields."""
+                 real_facets=False, finish_passes=1, colpass=None):
+    """Analytic FLOP count -> tflops / mfu_pct fields.
+
+    `colpass` is the column-pass body the forward executor actually ran
+    (its `last_plan["colpass"]` — slab plans resolve from facet_group,
+    not the full stack), so the FLOP shape matches the executed program.
+    """
     from swiftly_tpu.utils.flops import (
         forward_batched_flops,
         forward_sampled_flops,
@@ -300,14 +305,14 @@ def _flop_fields(config, facet_configs, subgrid_configs, mode, elapsed,
     if mode == "streamed":
         flops = forward_sampled_flops(
             core, real_facets=real_facets, finish_passes=finish_passes,
-            **kwargs,
+            colpass=colpass, **kwargs,
         )
     elif mode == "roundtrip-streamed":
         from swiftly_tpu.utils.flops import backward_sampled_flops
 
         flops = forward_sampled_flops(
             core, real_facets=real_facets, finish_passes=finish_passes,
-            **kwargs,
+            colpass=colpass, **kwargs,
         ) + backward_sampled_flops(core, **kwargs)
     elif mode == "roundtrip":
         flops = forward_batched_flops(core, **kwargs) + backward_batched_flops(
@@ -727,6 +732,7 @@ def run_one(config_name, mode):
         _flop_fields(
             config, facet_configs, subgrid_configs, mode, elapsed,
             real_facets=real_facets, finish_passes=finish_passes,
+            colpass=(extra.get("plan") or {}).get("colpass"),
         )
     )
     return result

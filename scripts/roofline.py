@@ -157,7 +157,10 @@ def main():
     m1_c = jnp.asarray(
         np.asarray([[mk[1] for mk in row] for row in ms]), rdt
     ).reshape(n_chunks, chunk, S, -1)
-    stepfn = _column_group_step_j(core, xA, chunk)
+    from swiftly_tpu.utils.flops import resolve_colpass
+
+    colpass = resolve_colpass(core, F)
+    stepfn = _column_group_step_j(core, xA, chunk, colpass)
     foffs0 = jnp.asarray(np.asarray(fwd.stack.offs0))
     foffs1 = jnp.asarray(np.asarray(fwd.stack.offs1))
 
@@ -168,16 +171,29 @@ def main():
         return stepfn(acc, buf, foffs0, foffs1, so_c)
 
     dt_column, acc = timed(run_step, buf)
-    col_flops = G * F * (fft_flops(yN, m) + 6 * m * yN) + G * S * F * (
-        fft_flops(m, m) + 6 * m * m + fft_flops(m, xM) + 6 * xM * m
-    ) + G * S * 2 * (F - 1) * xM * xM
+    if colpass == "einsum":
+        col_flops = (
+            G * F * (fft_flops(yN, m) + 6 * m * yN)  # prep1
+            + G * F * 8 * xM * m * yN  # H = A0 @ NMBF_BF
+            + G * S * 8 * xM * xM * F * m  # stage-2 contraction
+        )
+        col_note = (
+            f"prepare + operator einsums (K={F * m}) for {G} columns x "
+            f"{S} subgrids (all {F} facets)"
+        )
+    else:
+        col_flops = G * F * (fft_flops(yN, m) + 6 * m * yN) + G * S * F * (
+            fft_flops(m, m) + 6 * m * m + fft_flops(m, xM) + 6 * xM * m
+        ) + G * S * 2 * (F - 1) * xM * xM
+        col_note = (
+            f"prepare + per-subgrid small matmuls for {G} columns x "
+            f"{S} subgrids (all {F} facets)"
+        )
     emit("column", dt_column, col_flops,
-         bytes_touched=buf.nbytes + acc.nbytes,
-         note=f"prepare + per-subgrid small matmuls for {G} columns x "
-              f"{S} subgrids (all {F} facets)")
+         bytes_touched=buf.nbytes + acc.nbytes, note=col_note)
 
     # -- finish -----------------------------------------------------------
-    finfn = _column_group_finish_j(core, xA)
+    finfn = _column_group_finish_j(core, xA, colpass)
 
     def run_fin(acc):
         return finfn(acc, so_c, m0_c, m1_c)
@@ -188,11 +204,16 @@ def main():
         return run_fin(a)
 
     dt_fin, fin = timed(fin_fresh, 0)
-    fin_flops = G * S * (
-        fft_flops(xM, xM) + fft_flops(xM, xA) + 4 * xA * xA
-    )
+    if colpass == "einsum":
+        fin_flops = G * S * 4 * xA * xA  # crop + masks only
+        fin_note = "crop + masks (finish iFFTs live in the einsum ops)"
+    else:
+        fin_flops = G * S * (
+            fft_flops(xM, xM) + fft_flops(xM, xA) + 4 * xA * xA
+        )
+        fin_note = "once per group since r4 (was once per slab)"
     emit("finish", dt_fin, fin_flops, bytes_touched=fin.nbytes,
-         note="once per group since r4 (was once per slab)")
+         note=fin_note)
 
     # Full-cover bracketing from the per-group stage sum. Each timed
     # stage already embeds one dispatch+pull (~t_lat), so the

@@ -62,6 +62,7 @@ def _rss_gib():
 
 from ..ops.core import (
     add_to_facet_math,
+    add_to_subgrid_math,
     extract_from_facet_math,
     finish_facet_math,
     prepare_facet_math,
@@ -195,20 +196,209 @@ def _facet_pass_fwd_sharded(core, mesh):
     )
 
 
-def _column_pass_fwd_fn(core, subgrid_size, axis_name=None, finish=True):
+# -- operator-matrix (einsum) column pass -----------------------------------
+#
+# Every per-facet op in the forward column pass after the axis-1 prep is
+# LINEAR with a statically-shaped [xM, m] operator: the axis-0 chain
+# fft -> roll -> Fn window -> wrapped_embed (`add_to_subgrid_math`) is a
+# matrix A0_f, the axis-1 chain a matrix op1_f, and the finish iFFTs fold
+# into them (iFFT along an axis commutes with cropping the OTHER axis).
+# The whole column pass then collapses to two big einsums,
+#
+#   H    = A0_f @ NMBF_BF_f                  [F, xM, yN]   (shared by all S)
+#   P_s  = sum_f gather_s(H_f) @ op1_f^T     [xM, xM]      (K = F*m)
+#
+# and the per-subgrid finish is a crop + mask (no FFT left). Versus the
+# per-facet chain this roughly doubles the matmul FLOPs but removes the
+# scan-over-facets accumulator traffic, the per-(facet, subgrid) rolls and
+# embeds, and the m-sized matmul tiles that ran at ~9% of the MXU ceiling
+# (measured, scripts/roofline.py): the K = F*m contraction folds the facet
+# reduction into the MXU. The operators are built IN-TRACE by applying the
+# existing `*_math` chain to an identity block — correctness by
+# construction, ~1 ms per program, and both spmd modes reuse the body.
+#
+# `SWIFTLY_COLPASS` selects the body (einsum|fft|auto, default auto; read
+# at TRACE time like SWIFTLY_PRECISION — the lru-cached jits bake it in).
+# "auto" picks per program from the stage-2 contraction depth
+# (`utils.flops.resolve_colpass`, measured threshold): einsum for
+# full-facet-stack programs (resident/host paths), the fft chain for
+# thin facet slabs where the contraction is too shallow to pay for the
+# einsum pass's extra FLOPs.
+
+
+from ..utils.flops import (  # noqa: E402
+    resolve_colpass as _resolve_colpass,
+    resolve_colpass_bwd as _resolve_colpass_bwd,
+)
+
+
+def _colpass_sblock() -> int:
+    """Subgrids per einsum block: bounds the [Sb, F, xM, m] gather
+    transient while keeping the stage-2 contraction MXU-wide."""
+    import os
+
+    return max(1, int(os.environ.get("SWIFTLY_COLPASS_SBLOCK", "64")))
+
+
+def _ceinsum(core, spec, a, b):
+    """Complex einsum (spec written for the logical axes): planar arrays
+    contract via 4 real MXU einsums, complex backends directly."""
+    import jax.numpy as jnp
+
+    if _planar(core):
+        from ..ops.planar_backend import _cmatmul
+
+        outr, outi = _cmatmul(
+            a[..., 0], a[..., 1], (b[..., 0], b[..., 1]), spec, a.dtype
+        )
+        return jnp.stack([outr, outi], axis=-1)
+    return jnp.einsum(spec, a, b)
+
+
+def _colpass_operators(core, foffs0, foffs1):
+    """Forward column-pass operators, built in-trace from an identity.
+
+    A0 [F, xM, m(,2)]: axis-0 `add_to_subgrid_math` with the finish iFFT
+    folded along the output axis. B1 [F, m, xM(,2)]: the axis-1 operator
+    in row-basis layout (B1[f, j, b] = op1_f[b, j]), iFFT folded, so the
+    stage-2 contraction is `X[..., j] . B1[f, j, b]`.
+    """
+    import jax.numpy as jnp
+
+    p = core._p
+    m, xM = core.xM_yN_size, core.xM_size
+    if _planar(core):
+        eye = (
+            jnp.zeros((m, m, 2), core.dtype)
+            .at[:, :, 0]
+            .set(jnp.eye(m, dtype=core.dtype))
+        )
+    else:
+        eye = jnp.eye(m, dtype=core.dtype)
+
+    def a0(off0):
+        A = add_to_subgrid_math(p, core._Fn, xM, core.N, eye, off0, 0)
+        return p.ifft(A, 0)
+
+    def b1(off1):
+        B = add_to_subgrid_math(p, core._Fn, xM, core.N, eye, off1, 1)
+        return p.ifft(B, 1)
+
+    return jax.vmap(a0)(foffs0), jax.vmap(b1)(foffs1)
+
+
+def _crop_masked_subgrid(core, P, sg_offs, subgrid_size, mask0, mask1):
+    """Finish an IMAGE-space padded subgrid: crop both axes + masks (the
+    iFFTs already live in the einsum operators)."""
+    p = core._p
+    out = p.wrapped_extract(P, subgrid_size, sg_offs[0], 0)
+    out = p.wrapped_extract(out, subgrid_size, sg_offs[1], 1)
+    out = _mask_along(p, out, mask0, 0)
+    return _mask_along(p, out, mask1, 1)
+
+
+def _colpass_einsum_body(
+    core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0, masks1,
+    axis_name=None, finish=True,
+):
+    """One column through the einsum column pass, with prebuilt `ops`
+    (so group callers hoist the operator build out of their column loop).
+    """
+    import jax.numpy as jnp
+
+    p = core._p
+    m, yN = core.xM_yN_size, core.yN_size
+    A0, B1 = ops
+
+    def prep1(x, off1):
+        return prepare_facet_math(p, core._Fb, yN, x, off1, 1)
+
+    NMBF_BF = jax.vmap(prep1)(NMBF, foffs1)  # [F, m, yN(,2)]
+    H = _ceinsum(core, "fai,fij->faj", A0, NMBF_BF)  # [F, xM, yN(,2)]
+
+    def block(so_blk):
+        def gather(so):
+            return extract_from_facet_math(
+                p, m, core.N, yN, H, so[1], 2
+            )  # [F, xM, m(,2)]
+
+        X = jax.vmap(gather)(so_blk)  # [Sb, F, xM, m(,2)]
+        return _ceinsum(core, "sfaj,fjb->sab", X, B1)  # [Sb, xM, xM(,2)]
+
+    S = sg_offs.shape[0]
+    Sb = min(_colpass_sblock(), S)
+    nb = -(-S // Sb)
+    if nb == 1:
+        P = block(sg_offs)
+    else:
+        pad = nb * Sb - S
+        so_p = (
+            jnp.concatenate([sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)])
+            if pad
+            else sg_offs
+        )
+        P = jax.lax.map(block, so_p.reshape((nb, Sb) + so_p.shape[1:]))
+        P = P.reshape((nb * Sb,) + P.shape[2:])[:S]
+    if axis_name is not None:
+        P = jax.lax.psum(P, axis_name)
+    if not finish:
+        return P
+
+    def fin(Pi, so, m0, m1):
+        return _crop_masked_subgrid(core, Pi, so, subgrid_size, m0, m1)
+
+    return jax.vmap(fin)(P, sg_offs, masks0, masks1)
+
+
+def _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name=None, finish=True):
+    def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
+        ops = _colpass_operators(core, foffs0, foffs1)
+        return _colpass_einsum_body(
+            core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0,
+            masks1, axis_name, finish,
+        )
+
+    return fn
+
+
+def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
 
-    The facet reduction is a lax.scan accumulating one [S, xM, xM]
-    buffer (each step: one facet's contributions to ALL S subgrids,
-    S-batched matmuls) — a vmap-over-S-of-sum-over-F materialises every
-    (S, F) contribution block at once, which OOMs a 16 GiB chip at the
-    32k scale. With `axis_name`, F is the local facet shard and the
-    reduction finishes with ONE psum over the accumulated partials —
-    the streamed pipeline's only collective.
+    Trace-time dispatcher: the operator-matrix einsum body when the
+    program's facet count makes its stage-2 contraction MXU-deep
+    (`resolve_colpass`), the per-facet fft chain otherwise. Callers that
+    need PRE-finish partials (the facet-slab group step) pick a body
+    explicitly instead — the two bodies' partials live in different
+    spaces (einsum: image, fft: grid) and must pair with the matching
+    group finish.
+    """
+    ein = _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name)
+    fft_body = _column_pass_fwd_fft_fn(core, subgrid_size, axis_name)
 
-    With ``finish=False`` the PRE-finish partials [S, xM, xM] are
-    returned (no masks consumed): the facet-slab path accumulates those
-    across slabs and finishes ONCE per column group — at 64k the
+    def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
+        body = (
+            ein
+            if _resolve_colpass(core, NMBF.shape[0]) == "einsum"
+            else fft_body
+        )
+        return body(NMBF, foffs0, foffs1, sg_offs, masks0, masks1)
+
+    return fn
+
+
+def _column_pass_fwd_fft_fn(core, subgrid_size, axis_name=None, finish=True):
+    """The per-facet fft-chain column pass: the facet reduction is a
+    lax.scan accumulating one [S, xM, xM] buffer (each step: one facet's
+    contributions to ALL S subgrids, S-batched matmuls) — a
+    vmap-over-S-of-sum-over-F materialises every (S, F) contribution
+    block at once, which OOMs a 16 GiB chip at the 32k scale. With
+    `axis_name`, F is the local facet shard and the reduction finishes
+    with ONE psum over the accumulated partials — the streamed
+    pipeline's only collective.
+
+    With ``finish=False`` the PRE-finish GRID-space partials [S, xM, xM]
+    are returned (no masks consumed): the facet-slab path accumulates
+    those across slabs and finishes ONCE per column group — at 64k the
     per-slab finish was 44% of all FLOPs.
     """
     p = core._p
@@ -279,7 +469,7 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
     column alone on v5e).
     """
     m = core.xM_yN_size
-    colfn = _column_pass_fwd_fn(core, subgrid_size, axis_name)
+    colfn = _column_pass_fwd_fft_fn(core, subgrid_size, axis_name)
 
     def fn(buf, foffs0, foffs1, sg_offs_g, masks0_g, masks1_g):
         F = buf.shape[0]
@@ -287,6 +477,24 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
         NMBF_g = jax.numpy.moveaxis(
             buf.reshape((F, G, m) + buf.shape[2:]), 1, 0
         )  # [G, F, m, yB(,2)]
+
+        if _resolve_colpass(core, F) == "einsum":
+            # operators hoisted across the group's columns; columns run
+            # sequentially (lax.map) — each column's einsums are already
+            # MXU-wide, and a G-batched vmap would scale the [F, xM, yN]
+            # H transient by G (OOM at 32k G=9)
+            ops = _colpass_operators(core, foffs0, foffs1)
+
+            def per_col(xs):
+                NMBF, so, m0, m1 = xs
+                return _colpass_einsum_body(
+                    core, subgrid_size, ops, NMBF, foffs1, so, m0, m1,
+                    axis_name,
+                )
+
+            return jax.lax.map(
+                per_col, (NMBF_g, sg_offs_g, masks0_g, masks1_g)
+            )
 
         def per_col(NMBF, so, m0, m1):
             return colfn(NMBF, foffs0, foffs1, so, m0, m1)
@@ -314,8 +522,133 @@ def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
     )
 
 
+def _bwd_colpass_operators(core, foffs0, foffs1):
+    """Backward (adjoint) column-pass operators, built in-trace from an
+    identity block.
+
+    E0 [F, m, xM(,2)]: the axis-0 `extract_from_subgrid_math` chain with
+    the prepare-fft folded in (fft along an axis commutes with the other
+    axis's ops). E1 [F, xM, m(,2)]: the axis-1 chain in row-basis layout
+    (E1[f, b, j] = op1_f[j, b]).
+    """
+    import jax.numpy as jnp
+
+    from ..ops.core import extract_from_subgrid_math
+
+    p = core._p
+    m, xM = core.xM_yN_size, core.xM_size
+    if _planar(core):
+        eye = (
+            jnp.zeros((xM, xM, 2), core.dtype)
+            .at[:, :, 0]
+            .set(jnp.eye(xM, dtype=core.dtype))
+        )
+    else:
+        eye = jnp.eye(xM, dtype=core.dtype)
+
+    def e0(off0):
+        return extract_from_subgrid_math(
+            p, core._Fn, m, xM, core.N, p.fft(eye, 0), off0, 0
+        )
+
+    def e1(off1):
+        return extract_from_subgrid_math(
+            p, core._Fn, m, xM, core.N, p.fft(eye, 1), off1, 1
+        )
+
+    return jax.vmap(e0)(foffs0), jax.vmap(e1)(foffs1)
+
+
+def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
+    """Operator-matrix backward column pass (adjoint of the forward
+    einsum pass): the per-(facet, subgrid) extract chains collapse into
+    two K=xM einsums; the per-subgrid scatter into the [F, m, yN]
+    accumulator stays a scan (its positions are per-subgrid)."""
+    import jax.numpy as jnp
+
+    p = core._p
+    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+
+    def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
+        F = foffs0.shape[0]
+        E0, E1 = _bwd_colpass_operators(core, foffs0, foffs1)
+        zeros = jnp.zeros(
+            (F, m, yN) + subgrids.shape[3:], dtype=subgrids.dtype
+        )
+        if axis_name is not None:
+            zeros = varying(zeros, axis_name)
+
+        def emb_one(sg, so):
+            x = p.wrapped_embed(sg, xM, so[0], 0)
+            return p.wrapped_embed(x, xM, so[1], 1)
+
+        S = sg_offs.shape[0]
+        Sb = min(_colpass_sblock(), S)
+        nb = -(-S // Sb)
+        pad = nb * Sb - S
+        sg_p, so_p = subgrids, sg_offs
+        if pad:
+            # zero-padded subgrids contribute exactly nothing to the fold
+            zpad = jnp.zeros(
+                (pad,) + subgrids.shape[1:], dtype=subgrids.dtype
+            )
+            sg_p = jnp.concatenate([subgrids, zpad])
+            so_p = jnp.concatenate(
+                [sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)]
+            )
+        sg_b = sg_p.reshape((nb, Sb) + sg_p.shape[1:])
+        so_b = so_p.reshape((nb, Sb) + so_p.shape[1:])
+
+        def block_fold(acc, xs):
+            sg_blk, so_blk = xs
+            emb = jax.vmap(emb_one)(sg_blk, so_blk)  # [Sb, xM, xM(,2)]
+            Y = _ceinsum(core, "fia,sab->sfib", E0, emb)
+            Z = _ceinsum(core, "sfib,fbj->sfij", Y, E1)  # [Sb, F, m, m]
+
+            def fold(a2, ys):
+                z, so = ys
+                return (
+                    a2 + add_to_facet_math(p, yN, core.N, z, so[1], 2),
+                    None,
+                )
+
+            acc, _ = jax.lax.scan(fold, acc, (Z, so_blk))
+            return acc, None
+
+        acc, _ = jax.lax.scan(block_fold, zeros, (sg_b, so_b))
+
+        def fin(a, off1, m1):
+            x = finish_facet_math(p, core._Fb, facet_size, a, off1, 1)
+            return _mask_along(p, x, m1, 1)
+
+        return jax.vmap(fin)(acc, foffs1, masks1)
+
+    return fn
+
+
 def _column_pass_bwd_fn(core, facet_size, axis_name=None):
-    """A column's subgrids [S, xA, xA] -> NAF_BMNAF rows [F, m, yB]."""
+    """A column's subgrids [S, xA, xA] -> NAF_BMNAF rows [F, m, yB].
+
+    Trace-time dispatcher (einsum vs fft chain) on the program's facet
+    count — `resolve_colpass_bwd`, overridable with SWIFTLY_COLPASS_BWD.
+    Both bodies produce identical finished rows, so unlike the forward
+    no caller pairing is needed."""
+    ein = _column_pass_bwd_einsum_fn(core, facet_size, axis_name)
+    fft_body = _column_pass_bwd_fft_fn(core, facet_size, axis_name)
+
+    def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
+        body = (
+            ein
+            if _resolve_colpass_bwd(core, foffs0.shape[0]) == "einsum"
+            else fft_body
+        )
+        return body(subgrids, sg_offs, foffs0, foffs1, masks1)
+
+    return fn
+
+
+def _column_pass_bwd_fft_fn(core, facet_size, axis_name=None):
+    """The per-facet fft-chain backward column pass."""
     p = core._p
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
@@ -471,6 +804,57 @@ def _mulmod(a, b, yN):
     return jnp.mod(hi + a * b_lo, yN)
 
 
+def _sampled_phases(core, residues):
+    import jax.numpy as jnp
+
+    theta = (2 * np.pi / core.yN_size) * residues
+    return jnp.cos(theta), jnp.sin(theta)
+
+
+def _sampled_A_real(core, yB, dt, krows):
+    """The sampled-DFT phase matrix pair (A_re, A_im) [R, yB] for real
+    facets — krows-dependent only, so group-scan callers hoist it out of
+    their slab loop."""
+    import jax.numpy as jnp
+
+    yN = core.yN_size
+    fb = core._p.extract_mid(core._Fb, yB, 0) / yN  # [yB] real
+    j = jnp.arange(yB, dtype=jnp.int32)
+    a_cos, a_sin = _sampled_phases(
+        core, _mulmod(krows[:, None], j[None, :], yN)
+    )
+    return (a_cos * fb[None, :]).astype(dt), (a_sin * fb[None, :]).astype(dt)
+
+
+def _sampled_apply_real(core, A_re, A_im, Fr, e0, krows):
+    """Apply a prebuilt sampled phase matrix to a real facet slab
+    [F, yB, yB] -> rows [F, R, yB, 2] (the per-facet e0 phase rotation
+    included). Single source for `_facet_pass_sampled_fn(real)` and the
+    whole-group fused program."""
+    import jax.numpy as jnp
+
+    yN = core.yN_size
+    dt = Fr.dtype
+    from ..ops.planar_backend import matmul_precision
+
+    prec = matmul_precision()
+    f = lambda a, b: jnp.einsum("rj,fjc->frc", a, b, precision=prec)
+    out_re = f(A_re, Fr)
+    out_im = f(A_im, Fr)
+    p_cos, p_sin = _sampled_phases(
+        core, _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
+    )  # [F, R]
+    p_cos = p_cos.astype(dt)[..., None]
+    p_sin = p_sin.astype(dt)[..., None]
+    return jnp.stack(
+        [
+            out_re * p_cos - out_im * p_sin,
+            out_re * p_sin + out_im * p_cos,
+        ],
+        axis=-1,
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _facet_pass_sampled_fn(core, real_facets=False):
     """facets [F, yB, Y(,2)] -> sampled contribution rows [F, R, Y(,2)].
@@ -502,35 +886,8 @@ def _facet_pass_sampled_fn(core, real_facets=False):
             raise ValueError("real_facets requires the planar backend")
 
         def fn(Fr, e0, krows):
-            yB = Fr.shape[1]
-            dt = Fr.dtype
-            fb = core._p.extract_mid(core._Fb, yB, 0) / yN  # [yB] real
-            j = jnp.arange(yB, dtype=jnp.int32)
-            a_cos, a_sin = phases(_mulmod(krows[:, None], j[None, :], yN))
-            A_re = (a_cos * fb[None, :]).astype(dt)
-            A_im = (a_sin * fb[None, :]).astype(dt)
-            from ..ops.planar_backend import matmul_precision
-
-            prec = matmul_precision()
-            f = lambda a, b: jnp.einsum(
-                "rj,fjc->frc", a, b, precision=prec
-            )
-            out_re = f(A_re, Fr)
-            out_im = f(A_im, Fr)
-            p_cos, p_sin = phases(
-                _mulmod(
-                    e0.astype(jnp.int32)[:, None], krows[None, :], yN
-                )
-            )  # [F, R]
-            p_cos = p_cos.astype(dt)[..., None]
-            p_sin = p_sin.astype(dt)[..., None]
-            return jnp.stack(
-                [
-                    out_re * p_cos - out_im * p_sin,
-                    out_re * p_sin + out_im * p_cos,
-                ],
-                axis=-1,
-            )
+            A_re, A_im = _sampled_A_real(core, Fr.shape[1], Fr.dtype, krows)
+            return _sampled_apply_real(core, A_re, A_im, Fr, e0, krows)
 
     elif _planar(core):
         # Planes arrive as SEPARATE arrays (Fr, Fi), not a trailing axis:
@@ -849,7 +1206,7 @@ def _synth_slab_j(core, Fg, yB):
 # one sampled group buffer — bounded regardless of N.
 
 
-def _column_group_step_fn(core, subgrid_size, chunk):
+def _column_group_step_fn(core, subgrid_size, chunk, colpass):
     """One facet slab's PRE-FINISH contribution, added into the group acc.
 
     acc [n_chunks, chunk, S, xM, xM(,2)]; buf [Fg, G*m, yB(,2)] is the
@@ -859,9 +1216,18 @@ def _column_group_step_fn(core, subgrid_size, chunk):
     runs ONCE per group (`_column_group_finish_j`) after all slabs
     accumulated — finishing per slab cost n_slabs-1 extra finish passes,
     44% of all FLOPs at 64k.
+
+    `colpass` (einsum|fft) is EXPLICIT here: the two bodies accumulate
+    partials in different spaces (image vs grid), so the executor
+    resolves the choice once (from its facet_group) and passes the same
+    value to this step and to `_column_group_finish_j`.
     """
     m = core.xM_yN_size
-    colfn = _column_pass_fwd_fn(core, subgrid_size, finish=False)
+    einsum_mode = colpass == "einsum"
+    colfn = (
+        None if einsum_mode
+        else _column_pass_fwd_fft_fn(core, subgrid_size, finish=False)
+    )
 
     def fn(acc, buf, foffs0, foffs1, sg_offs_g):
         Fg = buf.shape[0]
@@ -872,12 +1238,28 @@ def _column_group_step_fn(core, subgrid_size, chunk):
         )  # [G, Fg, m, yB(,2)]
         NMBF_c = NMBF_g.reshape((n_chunks, acc.shape[1]) + NMBF_g.shape[1:])
 
-        def step(carry, xs):
-            c, nm, so = xs
-            out = jax.vmap(colfn, in_axes=(0, None, None, 0))(
-                nm, foffs0, foffs1, so
-            )  # [chunk, S, xM, xM(,2)]
-            return carry.at[c].add(out), None
+        if einsum_mode:
+            # operator build hoisted out of the chunk scan (loop-invariant)
+            ops = _colpass_operators(core, foffs0, foffs1)
+
+            def one_col(nm, so):
+                return _colpass_einsum_body(
+                    core, subgrid_size, ops, nm, foffs1, so, None, None,
+                    finish=False,
+                )
+
+            def step(carry, xs):
+                c, nm, so = xs
+                out = jax.vmap(one_col)(nm, so)  # [chunk, S, xM, xM(,2)]
+                return carry.at[c].add(out), None
+        else:
+
+            def step(carry, xs):
+                c, nm, so = xs
+                out = jax.vmap(colfn, in_axes=(0, None, None, 0))(
+                    nm, foffs0, foffs1, so
+                )  # [chunk, S, xM, xM(,2)]
+                return carry.at[c].add(out), None
 
         idx = jax.numpy.arange(n_chunks)
         acc, _ = jax.lax.scan(step, acc, (idx, NMBF_c, sg_offs_g))
@@ -887,12 +1269,14 @@ def _column_group_step_fn(core, subgrid_size, chunk):
 
 
 @functools.lru_cache(maxsize=None)
-def _column_group_step_j(core, subgrid_size, chunk):
-    return _jit(donate=(0,))(_column_group_step_fn(core, subgrid_size, chunk))
+def _column_group_step_j(core, subgrid_size, chunk, colpass):
+    return _jit(donate=(0,))(
+        _column_group_step_fn(core, subgrid_size, chunk, colpass)
+    )
 
 
 @functools.lru_cache(maxsize=None)
-def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB):
+def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB, colpass):
     """ONE program per facet slab: sparse synthesis -> sampled-DFT pass
     -> column-group step, with the group accumulator donated through.
 
@@ -900,11 +1284,15 @@ def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB):
     (measured, scripts/roofline.py); the unfused slab path cost three
     dispatches per slab. Fusing also lets XLA schedule the scatter and
     einsum together and drops the intermediate slab buffer's round trip
-    through HBM allocation."""
+    through HBM allocation. Fusing FURTHER — the whole slab loop as one
+    lax.scan program per column group — was measured 3x SLOWER at 64k
+    (188.6 s vs 61.7 s full cover): the nested while-loops (slab scan >
+    chunk scan > S-block map) serialize XLA's scheduling, so one
+    dispatch per slab with the depth-2 checksum pipeline stands."""
     import jax.numpy as jnp
 
     sam = _facet_pass_sampled_fn(core, real_facets=True)
-    step = _column_group_step_fn(core, subgrid_size, chunk)
+    step = _column_group_step_fn(core, subgrid_size, chunk, colpass)
     dt = _np_dtype(core)
 
     def fn(acc, f, r, c, v, e0, krows, foffs0, foffs1, so_c):
@@ -915,13 +1303,23 @@ def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB):
     return _jit(donate=(0,))(fn)
 
 
-def _column_group_finish_fn(core, subgrid_size):
+def _column_group_finish_fn(core, subgrid_size, colpass):
     """Finish a whole group's accumulated partials in one program:
     [n_chunks, chunk, S, xM, xM(,2)] -> finished subgrids
-    [n_chunks, chunk, S, xA, xA(,2)] (crop iFFTs + masks)."""
+    [n_chunks, chunk, S, xA, xA(,2)]. The einsum column pass accumulates
+    IMAGE-space partials (iFFTs folded into its operators), so its
+    finish is crop + masks; the fft pass accumulates grid-space partials
+    and finishes with the crop iFFTs. `colpass` must be the value the
+    executor passed to the `_column_group_step_fn` that filled the
+    accumulator."""
+    einsum_mode = colpass == "einsum"
 
     def fn(acc, sg_offs_g, masks0_g, masks1_g):
         def fin(summed, so, m0, m1):
+            if einsum_mode:
+                return _crop_masked_subgrid(
+                    core, summed, so, subgrid_size, m0, m1
+                )
             return finish_masked_subgrid(
                 core, summed, so, subgrid_size, m0, m1
             )
@@ -934,8 +1332,10 @@ def _column_group_finish_fn(core, subgrid_size):
 
 
 @functools.lru_cache(maxsize=None)
-def _column_group_finish_j(core, subgrid_size):
-    return _jit(donate=(0,))(_column_group_finish_fn(core, subgrid_size))
+def _column_group_finish_j(core, subgrid_size, colpass):
+    return _jit(donate=(0,))(
+        _column_group_finish_fn(core, subgrid_size, colpass)
+    )
 
 
 
@@ -1392,7 +1792,15 @@ class StreamedForward:
         )
         col_offs0 = list(groups)
         G = self.col_group or self._auto_col_group(len(col_offs0))
-        self.last_plan = {"mode": "resident", "col_group": G}
+        self.last_plan = {
+            "mode": "resident", "col_group": G,
+            # resolve from the PER-SHARD facet count: on a mesh the
+            # shard_map bodies see local facets only, and the recorded
+            # body must be the executed one
+            "colpass": _resolve_colpass(
+                core, base.stack.n_total // _mesh_size(base.mesh)
+            ),
+        }
         if base.mesh is not None:
             samfn = _facet_pass_sampled_sharded(
                 core, base.mesh, self._facets_real
@@ -1509,28 +1917,40 @@ class StreamedForward:
                 G = len(col_offs0)
                 chunk = next(c for c in (4, 3, 2, 1) if G % c == 0)
             else:
-                G = grouped_col_group_for_budget(
-                    base, budget, len(col_offs0), S, subgrid_size,
-                    self._facets_real, Fg, chunk, slab_depth=depth,
+                # evaluate every (chunk, G) pair: chunk scales the
+                # in-step transients, so a SMALLER chunk can buy a
+                # bigger G — and fewer groups (fewer sampled dispatches
+                # at the tunnel's latency floor) dominates the cost.
+                # Tie-break on larger chunk (batches the fft body's
+                # small matmuls; harmless for the einsum body).
+                G, chunk = max(
+                    (
+                        (
+                            max(1, (Gc // c) * c if Gc >= c else Gc),
+                            c,
+                        )
+                        for c in (4, 3, 2, 1)
+                        for Gc in (
+                            grouped_col_group_for_budget(
+                                base, budget, len(col_offs0), S,
+                                subgrid_size, self._facets_real, Fg, c,
+                                slab_depth=depth,
+                            ),
+                        )
+                    ),
+                    key=lambda t: (t[0], t[1]),
                 )
-                # round G down as little as possible: the largest
-                # multiple of any chunk in (4, 3, 2) wins (G=7 -> 6 with
-                # chunk 3, not 4 with chunk 4 — fewer groups beats a
-                # bigger small-matmul batch)
-                if G > 1:
-                    G, chunk = max(
-                        (((G // c) * c, c) for c in (4, 3, 2)),
-                        key=lambda t: (t[0], t[1]),
-                    )
         chunk = min(chunk, G)
         G = max(1, (G // chunk) * chunk)
         n_chunks = G // chunk
+        colpass = _resolve_colpass(core, Fg)
         self.last_plan = {
             "mode": "grouped", "col_group": G, "facet_group": Fg,
             "n_slabs": n_slabs, "slab_depth": depth,
             "facet_source": (
                 "device-synth-sparse" if self._facets_sparse else "host"
             ),
+            "colpass": colpass,
         }
 
         # per-slab facet metadata, padded with zero facets to F_pad
@@ -1576,10 +1996,12 @@ class StreamedForward:
             return tuple(bufs)
 
         samfn = _facet_pass_sampled_j(core, self._facets_real)
-        stepfn = _column_group_step_j(core, subgrid_size, chunk)
-        finfn = _column_group_finish_j(core, subgrid_size)
+        stepfn = _column_group_step_j(core, subgrid_size, chunk, colpass)
+        finfn = _column_group_finish_j(core, subgrid_size, colpass)
         fusedfn = (
-            _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB)
+            _fused_sparse_slab_step_j(
+                core, subgrid_size, chunk, Fg, yB, colpass
+            )
             if self._facets_sparse
             else None
         )
@@ -1776,9 +2198,22 @@ def grouped_col_group_for_budget(
     xM = core.xM_size
     xA = subgrid_size
     slab_b = slab_depth * facet_group * yB * yB * fsize
-    chunk_b = (
-        chunk * S * xM * xM + chunk * facet_group * m * core.yN_size
-    ) * dsize
+    if _resolve_colpass(core, facet_group) == "einsum":
+        # per column in the chunk vmap: prep1 rows, the H buffer plus its
+        # wrap-extended gather copy, and one [Sb, Fg, xM, m] gather block
+        Sb = min(_colpass_sblock(), S)
+        chunk_b = (
+            chunk * S * xM * xM
+            + chunk * facet_group * (
+                m * core.yN_size
+                + xM * (2 * core.yN_size + m)
+                + Sb * xM * m
+            )
+        ) * dsize
+    else:
+        chunk_b = (
+            chunk * S * xM * xM + chunk * facet_group * m * core.yN_size
+        ) * dsize
     # 4x the group buffer: the sampled pass materialises out_re/out_im
     # and their stacked pair next to the [Fg, G*m, yB] buffer and its
     # in-step transpose. The accumulator is pre-finish [S, xM, xM];
@@ -1836,11 +2271,28 @@ def col_group_for_budget(base, budget, n_cols, real=False):
     xA = base.config.max_subgrid_size
     xM = core.xM_size
     S = -(-core.N // xA)
-    col_b = (
-        2 * F * m * yB + F * m * core.yN_size
-        + S * xM * xM + 2 * S * xA * xA
-    ) * dsize
-    headroom = budget - facets_b - reserve
+    if _resolve_colpass(core, F) == "einsum":
+        # the einsum group fn maps columns SEQUENTIALLY, so the column
+        # transients (prep1 rows, H + its wrap-extended copy, the
+        # [Sb, F, xM, m] gather block, image partials) are flat — only
+        # the sampled group buffer (with its einsum plane transients and
+        # in-program transpose) and the in-flight output stacks scale
+        # with G
+        Sb = min(_colpass_sblock(), S)
+        flat_col = (
+            F * m * core.yN_size
+            + F * xM * (2 * core.yN_size + m)
+            + Sb * F * xM * m
+            + S * xM * xM
+        ) * dsize
+        col_b = (3 * F * m * yB + 2 * S * xA * xA) * dsize
+        headroom = budget - facets_b - reserve - flat_col
+    else:
+        col_b = (
+            2 * F * m * yB + F * m * core.yN_size
+            + S * xM * xM + 2 * S * xA * xA
+        ) * dsize
+        headroom = budget - facets_b - reserve
     if headroom <= col_b:
         logger.warning(
             "HBM budget %.2f GiB cannot fit the resident facet stack "

@@ -20,12 +20,15 @@ import os
 from ..ops.planar_backend import _DIRECT_MAX, _factor
 
 __all__ = [
+    "colpass_mode",
     "fft_flops",
     "forward_batched_flops",
     "forward_sampled_flops",
     "backward_batched_flops",
     "backward_sampled_flops",
     "peak_tflops",
+    "resolve_colpass",
+    "resolve_colpass_bwd",
 ]
 
 
@@ -42,15 +45,79 @@ def fft_flops(n: int, batch: int) -> int:
     return 8 * batch * n * (n1 + n2) + 6 * batch * n
 
 
-def _per_subgrid_flops(core, subgrid_size: int, n_facets: int) -> int:
+def colpass_mode() -> str:
+    """The streamed column-pass body (einsum|fft|auto, default auto) —
+    the single parser of SWIFTLY_COLPASS, shared with
+    `parallel.streamed` so the FLOP shape can never silently diverge
+    from the executed algorithm. Read at trace/report time."""
+    mode = os.environ.get("SWIFTLY_COLPASS", "auto")
+    if mode not in ("einsum", "fft", "auto"):
+        raise ValueError(
+            f"SWIFTLY_COLPASS must be einsum|fft|auto, got {mode!r}"
+        )
+    return mode
+
+
+# Minimum stage-2 contraction depth (facets_in_program * m) for "auto"
+# to pick the einsum FORWARD body. Measured on v5e
+# (docs/performance.md): despite ~2x the chain's matmul FLOPs, the
+# einsum body won at every measured forward shape — resident 32k
+# (K = 9*256: 14.6 -> 12.2 s) AND facet-slab 64k (K = 1*256:
+# 66.7 -> 61.7 s) — so "auto" currently resolves einsum everywhere;
+# the threshold stays as the tuning point should a shallower shape
+# regress.
+_COLPASS_MIN_K = 0
+
+
+def resolve_colpass(core, n_facets_in_program: int) -> str:
+    """The column-pass body a program with `n_facets_in_program` stacked
+    facets runs: the explicit SWIFTLY_COLPASS setting, or the measured
+    contraction-depth heuristic under "auto"."""
+    mode = colpass_mode()
+    if mode != "auto":
+        return mode
+    if n_facets_in_program * core.xM_yN_size >= _COLPASS_MIN_K:
+        return "einsum"
+    return "fft"
+
+
+def resolve_colpass_bwd(core, n_facets_in_program: int) -> str:
+    """Backward column-pass body: SWIFTLY_COLPASS_BWD if set (einsum|
+    fft), else fft — measured on v5e (32k roundtrip, G=3): the adjoint
+    einsum body's K=xM contractions cost ~2x the chain's FLOPs without
+    a facet-reduction payoff (the output stays per-facet), 66.3 s with
+    fft backward vs 80.4 s with einsum backward."""
+    mode = os.environ.get("SWIFTLY_COLPASS_BWD", "")
+    if mode:
+        if mode not in ("einsum", "fft"):
+            raise ValueError(
+                f"SWIFTLY_COLPASS_BWD must be einsum|fft, got {mode!r}"
+            )
+        return mode
+    return "fft"
+
+
+def _per_subgrid_flops(
+    core, subgrid_size: int, n_facets: int, colpass: str = "fft"
+) -> int:
     """FLOPs to turn one column's NMBF_BFs into one finished subgrid.
 
-    Per facet: add_to_subgrid axis 0 (fft size m over m rows) and axis 1
+    ``colpass="fft"`` (the batched path, and SWIFTLY_COLPASS=fft): per
+    facet, add_to_subgrid axis 0 (fft size m over m rows) and axis 1
     (fft size m over xM rows) plus the Fn windows; then one
     finish_subgrid (ifft size xM over xM rows, crop, ifft size xM over
     xA rows, crop).
+
+    ``colpass="einsum"``: one complex [xM, F*m] x [F*m, xM] stage-2
+    contraction (4 real matmuls) — the facet reduction and the finish
+    iFFTs are inside it / its operators, and the finish is a crop +
+    masks. The per-program operator build (~F*(m^3 + 2*xM*m^2) complex
+    ops, <0.5% of any cover) is excluded — understating, never
+    overstating, the achieved TFLOP/s.
     """
     m, xM = core.xM_yN_size, core.xM_size
+    if colpass == "einsum":
+        return 8 * xM * xM * n_facets * m + 4 * subgrid_size**2
     per_facet = (
         fft_flops(m, m) + 6 * m * m  # axis 0 fft + Fn window
         + fft_flops(m, xM) + 6 * xM * m  # axis 1 fft + Fn window
@@ -61,11 +128,16 @@ def _per_subgrid_flops(core, subgrid_size: int, n_facets: int) -> int:
     return n_facets * per_facet + finish + reduce_mask
 
 
-def _column_prepare_flops(core, n_facets: int) -> int:
+def _column_prepare_flops(core, n_facets: int, colpass: str = "fft") -> int:
     """Axis-1 preparation of one column's rows: per facet, Fb window +
-    ifft size yN over m rows."""
+    ifft size yN over m rows; the einsum column pass adds its hoisted
+    H = A0 @ NMBF_BF contraction ([xM, m] x [m, yN] complex per facet,
+    shared by all the column's subgrids)."""
     m, yN = core.xM_yN_size, core.yN_size
-    return n_facets * (fft_flops(yN, m) + 6 * m * yN)
+    base = n_facets * (fft_flops(yN, m) + 6 * m * yN)
+    if colpass == "einsum":
+        base += n_facets * 8 * core.xM_size * m * yN
+    return base
 
 
 def forward_batched_flops(
@@ -93,6 +165,7 @@ def forward_sampled_flops(
     core, n_facets: int, facet_size: int, n_columns: int,
     subgrids_per_column: int, subgrid_size: int,
     real_facets: bool = False, finish_passes: int = 1,
+    colpass: str | None = None,
 ) -> int:
     """Total FLOPs of the streamed device-resident (sampled-DFT) forward.
 
@@ -108,28 +181,34 @@ def forward_sampled_flops(
     """
     yB = facet_size
     m, xM = core.xM_yN_size, core.xM_size
+    if colpass is None:
+        colpass = resolve_colpass(core, n_facets)
     R = n_columns * m
     mm = 4 if real_facets else 8
     facet_pass = mm * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
-    columns = n_columns * _column_prepare_flops(core, n_facets)
+    columns = n_columns * _column_prepare_flops(core, n_facets, colpass)
     subgrids = (
         n_columns
         * subgrids_per_column
-        * _per_subgrid_flops(core, subgrid_size, n_facets)
+        * _per_subgrid_flops(core, subgrid_size, n_facets, colpass)
     )
-    extra_finish = (
-        (finish_passes - 1)
-        * n_columns
-        * subgrids_per_column
-        * (fft_flops(xM, xM) + fft_flops(xM, subgrid_size)
-           + 4 * subgrid_size**2)
-    )
+    if colpass == "einsum":
+        extra_finish = 0  # slab finish is a crop: no repeated iFFT passes
+    else:
+        extra_finish = (
+            (finish_passes - 1)
+            * n_columns
+            * subgrids_per_column
+            * (fft_flops(xM, xM) + fft_flops(xM, subgrid_size)
+               + 4 * subgrid_size**2)
+        )
     return facet_pass + columns + subgrids + extra_finish
 
 
 def backward_sampled_flops(
     core, n_facets: int, facet_size: int, n_columns: int,
     subgrids_per_column: int, subgrid_size: int,
+    colpass: str | None = None,
 ) -> int:
     """Total FLOPs of the streamed sampled-residency backward transform.
 
@@ -140,10 +219,21 @@ def backward_sampled_flops(
     """
     m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
     yB = facet_size
-    prep = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
-    extract = n_facets * (
-        fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
-    )
+    if colpass is None:
+        colpass = resolve_colpass_bwd(core, n_facets)
+    if colpass == "einsum":
+        # two K=xM complex einsums per (subgrid, facet) — the prepare
+        # ffts live inside the E0/E1 operators — plus the per-subgrid
+        # scatter-add into the [F, m, yN] accumulator
+        per_sg = n_facets * 8 * (m * xM * xM + m * m * xM)
+        per_sg += n_facets * 2 * m * yN  # one complex accumulator add
+        prep = 0
+        extract = per_sg
+    else:
+        prep = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
+        extract = n_facets * (
+            fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+        )
     col_fin = n_facets * (fft_flops(yN, m) + 6 * m * yB)
     R = n_columns * m
     fold = 8 * R * yB * (n_facets * yB) + 6 * n_facets * R * yB

@@ -644,3 +644,128 @@ def test_group_feeding_mesh_fallback():
             SOURCES,
         )
         assert err < 3e-10
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_colpass_einsum_matches_fft_body(backend):
+    """The operator-matrix einsum column pass is mathematically identical
+    to the per-facet fft chain (its operators are BUILT from that chain):
+    same finished subgrids, and step+finish pairs agree across modes."""
+    import jax.numpy as jnp
+
+    from swiftly_tpu.parallel.streamed import (
+        _column_group_finish_fn,
+        _column_group_step_fn,
+        _column_pass_fwd_einsum_fn,
+        _column_pass_fwd_fn,
+    )
+
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    core = config.core
+    from swiftly_tpu.api import _subgrid_masks
+    from swiftly_tpu.parallel.streamed import _group_full_columns
+
+    groups = _group_full_columns(subgrid_configs)
+    off0 = next(iter(groups))
+    items = groups[off0]
+    sg_offs = jnp.asarray([(sg.off0, sg.off1) for _, sg in items])
+    masks = [_subgrid_masks(sg) for _, sg in items]
+    rdt = core._Fb.dtype
+    m0 = jnp.asarray(np.asarray([mk[0] for mk in masks]), rdt)
+    m1 = jnp.asarray(np.asarray([mk[1] for mk in masks]), rdt)
+    F = len(facet_tasks)
+    foffs0 = jnp.asarray([fc.off0 for fc, _ in facet_tasks])
+    foffs1 = jnp.asarray([fc.off1 for fc, _ in facet_tasks])
+    rng = np.random.default_rng(7)
+    m, yB = core.xM_yN_size, facet_tasks[0][0].size
+    if backend == "planar":
+        NMBF = jnp.asarray(rng.standard_normal((F, m, yB, 2)))
+    else:
+        NMBF = jnp.asarray(
+            rng.standard_normal((F, m, yB))
+            + 1j * rng.standard_normal((F, m, yB))
+        )
+    size = subgrid_configs[0].size
+
+    import os
+
+    prior = os.environ.get("SWIFTLY_COLPASS")
+    ein = _column_pass_fwd_einsum_fn(core, size)(
+        NMBF, foffs0, foffs1, sg_offs, m0, m1
+    )
+    os.environ["SWIFTLY_COLPASS"] = "fft"
+    try:
+        fft_body = _column_pass_fwd_fn(core, size)(
+            NMBF, foffs0, foffs1, sg_offs, m0, m1
+        )
+    finally:
+        if prior is None:
+            del os.environ["SWIFTLY_COLPASS"]
+        else:
+            os.environ["SWIFTLY_COLPASS"] = prior
+    np.testing.assert_allclose(
+        np.asarray(ein), np.asarray(fft_body), atol=1e-10
+    )
+
+    # step(finish=False) + matching group finish agree for BOTH bodies
+    S = sg_offs.shape[0]
+    xM = core.xM_size
+    tail = (2,) if backend == "planar" else ()
+    # one-column "group": buf [F, 1*m, yB]
+    buf = NMBF.reshape((F, m) + NMBF.shape[2:])
+    so_g = sg_offs[None, None]
+    for colpass in ("einsum", "fft"):
+        acc0 = jnp.zeros((1, 1, S, xM, xM) + tail, NMBF.dtype)
+        step = _column_group_step_fn(core, size, 1, colpass)
+        fin = _column_group_finish_fn(core, size, colpass)
+        out_pair = fin(
+            step(acc0, buf, foffs0, foffs1, so_g),
+            so_g, m0[None, None], m1[None, None],
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_pair[0, 0]), np.asarray(fft_body), atol=1e-10
+        )
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_colpass_bwd_einsum_matches_fft_body(backend):
+    """The adjoint operator-matrix backward column pass (non-default;
+    SWIFTLY_COLPASS_BWD=einsum) equals the fft-chain body."""
+    import jax.numpy as jnp
+
+    from swiftly_tpu.parallel.streamed import (
+        _column_pass_bwd_einsum_fn,
+        _column_pass_bwd_fft_fn,
+        _group_full_columns,
+    )
+
+    config, _, subgrid_configs, facet_tasks = _setup(backend)
+    core = config.core
+    groups = _group_full_columns(subgrid_configs)
+    items = groups[next(iter(groups))]
+    sg_offs = jnp.asarray([(sg.off0, sg.off1) for _, sg in items])
+    F = len(facet_tasks)
+    foffs0 = jnp.asarray([fc.off0 for fc, _ in facet_tasks])
+    foffs1 = jnp.asarray([fc.off1 for fc, _ in facet_tasks])
+    yB = facet_tasks[0][0].size
+    rdt = core._Fb.dtype
+    from swiftly_tpu.api import _FacetStack
+
+    stack = _FacetStack([fc for fc, _ in facet_tasks])
+    m1 = jnp.asarray(np.asarray(stack.masks1), rdt)
+    rng = np.random.default_rng(11)
+    S, xA = sg_offs.shape[0], subgrid_configs[0].size
+    if backend == "planar":
+        sgs = jnp.asarray(rng.standard_normal((S, xA, xA, 2)))
+    else:
+        sgs = jnp.asarray(
+            rng.standard_normal((S, xA, xA))
+            + 1j * rng.standard_normal((S, xA, xA))
+        )
+    ein = _column_pass_bwd_einsum_fn(core, yB)(
+        sgs, sg_offs, foffs0, foffs1, m1
+    )
+    ref = _column_pass_bwd_fft_fn(core, yB)(
+        sgs, sg_offs, foffs0, foffs1, m1
+    )
+    np.testing.assert_allclose(np.asarray(ein), np.asarray(ref), atol=1e-10)
