@@ -160,60 +160,72 @@ def main():
     from swiftly_tpu.utils.flops import resolve_colpass
 
     colpass = resolve_colpass(core, F)
-    stepfn = _column_group_step_j(core, xA, chunk, colpass)
     foffs0 = jnp.asarray(np.asarray(fwd.stack.offs0))
     foffs1 = jnp.asarray(np.asarray(fwd.stack.offs1))
-
-    def run_step(buf):
-        acc = jnp.zeros(
-            (n_chunks, chunk, S, xM, xM, 2), dtype=np.float32
-        )
-        return stepfn(acc, buf, foffs0, foffs1, so_c)
-
-    dt_column, acc = timed(run_step, buf)
     if colpass == "einsum":
+        # time the kernel the resident executor actually runs: the group
+        # column pass (sequential columns, finish folded into the
+        # operators) — the slab step at full F with a chunk-wide vmap is
+        # a shape the einsum executor never chooses (it would OOM)
+        from swiftly_tpu.parallel.streamed import _column_pass_fwd_group_j
+
+        gcolfn = _column_pass_fwd_group_j(core, xA)
+        so_g = so_c.reshape(G, S, 2)
+        m0_g = m0_c.reshape(G, S, -1)
+        m1_g = m1_c.reshape(G, S, -1)
+
+        def run_col(buf):
+            return gcolfn(buf, foffs0, foffs1, so_g, m0_g, m1_g)
+
+        dt_column, out = timed(run_col, buf)
         col_flops = (
             G * F * (fft_flops(yN, m) + 6 * m * yN)  # prep1
             + G * F * 8 * xM * m * yN  # H = A0 @ NMBF_BF
             + G * S * 8 * xM * xM * F * m  # stage-2 contraction
         )
-        col_note = (
-            f"prepare + operator einsums (K={F * m}) for {G} columns x "
-            f"{S} subgrids (all {F} facets)"
-        )
+        emit("column", dt_column, col_flops,
+             bytes_touched=buf.nbytes + out.nbytes,
+             note=f"prepare + operator einsums (K={F * m}) incl. crop "
+                  f"for {G} columns x {S} subgrids (all {F} facets)")
+        dt_fin = 0.0  # folded into the einsum operators (crop+masks
+        # happen inside the column stage above) — no separate stage
     else:
+        stepfn = _column_group_step_j(core, xA, chunk, colpass)
+
+        def run_step(buf):
+            acc = jnp.zeros(
+                (n_chunks, chunk, S, xM, xM, 2), dtype=np.float32
+            )
+            return stepfn(acc, buf, foffs0, foffs1, so_c)
+
+        dt_column, acc = timed(run_step, buf)
         col_flops = G * F * (fft_flops(yN, m) + 6 * m * yN) + G * S * F * (
             fft_flops(m, m) + 6 * m * m + fft_flops(m, xM) + 6 * xM * m
         ) + G * S * 2 * (F - 1) * xM * xM
-        col_note = (
-            f"prepare + per-subgrid small matmuls for {G} columns x "
-            f"{S} subgrids (all {F} facets)"
-        )
-    emit("column", dt_column, col_flops,
-         bytes_touched=buf.nbytes + acc.nbytes, note=col_note)
+        emit("column", dt_column, col_flops,
+             bytes_touched=buf.nbytes + acc.nbytes,
+             note=f"prepare + per-subgrid small matmuls for {G} columns "
+                  f"x {S} subgrids (all {F} facets)")
 
-    # -- finish -----------------------------------------------------------
-    finfn = _column_group_finish_j(core, xA, colpass)
+        # -- finish -------------------------------------------------------
+        finfn = _column_group_finish_j(core, xA, colpass)
 
-    def run_fin(acc):
-        return finfn(acc, so_c, m0_c, m1_c)
+        def run_fin(acc):
+            return finfn(acc, so_c, m0_c, m1_c)
 
-    # acc is donated by finfn: rebuild it each rep inside the timed fn
-    def fin_fresh(_):
-        a = jnp.zeros((n_chunks, chunk, S, xM, xM, 2), dtype=np.float32)
-        return run_fin(a)
+        # acc is donated by finfn: rebuild each rep inside the timed fn
+        def fin_fresh(_):
+            a = jnp.zeros(
+                (n_chunks, chunk, S, xM, xM, 2), dtype=np.float32
+            )
+            return run_fin(a)
 
-    dt_fin, fin = timed(fin_fresh, 0)
-    if colpass == "einsum":
-        fin_flops = G * S * 4 * xA * xA  # crop + masks only
-        fin_note = "crop + masks (finish iFFTs live in the einsum ops)"
-    else:
+        dt_fin, fin = timed(fin_fresh, 0)
         fin_flops = G * S * (
             fft_flops(xM, xM) + fft_flops(xM, xA) + 4 * xA * xA
         )
-        fin_note = "once per group since r4 (was once per slab)"
-    emit("finish", dt_fin, fin_flops, bytes_touched=fin.nbytes,
-         note=fin_note)
+        emit("finish", dt_fin, fin_flops, bytes_touched=fin.nbytes,
+             note="once per group since r4 (was once per slab)")
 
     # Full-cover bracketing from the per-group stage sum. Each timed
     # stage already embeds one dispatch+pull (~t_lat), so the
@@ -223,7 +235,10 @@ def main():
     # between the bounds.
     n_groups = -(-len(col_offs0) // G)
     per_group = dt_sampled + dt_column + dt_fin
-    lo = n_groups * (per_group - 3 * t_lat)
+    # each timed stage embeds one dispatch+pull; einsum mode has two
+    # stages per group (sampled + column-with-crop), fft mode three
+    n_stages = 2 if colpass == "einsum" else 3
+    lo = n_groups * (per_group - n_stages * t_lat)
     hi = n_groups * (per_group + 2 * t_lat)
     print(json.dumps({
         "stage": "model",

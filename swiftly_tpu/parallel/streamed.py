@@ -219,11 +219,12 @@ def _facet_pass_fwd_sharded(core, mesh):
 #
 # `SWIFTLY_COLPASS` selects the body (einsum|fft|auto, default auto; read
 # at TRACE time like SWIFTLY_PRECISION — the lru-cached jits bake it in).
-# "auto" picks per program from the stage-2 contraction depth
-# (`utils.flops.resolve_colpass`, measured threshold): einsum for
-# full-facet-stack programs (resident/host paths), the fft chain for
-# thin facet slabs where the contraction is too shallow to pay for the
-# einsum pass's extra FLOPs.
+# "auto" resolves per program via `utils.flops.resolve_colpass`; the
+# einsum body measured faster at EVERY forward shape tried (resident
+# full-stack AND Fg=1 slabs), so auto currently picks einsum everywhere
+# — the contraction-depth threshold there is the tuning point should a
+# shallower shape regress. The BACKWARD pass defaults to the fft chain
+# (`resolve_colpass_bwd`): its adjoint einsums measured slower.
 
 
 from ..utils.flops import (  # noqa: E402
@@ -234,10 +235,13 @@ from ..utils.flops import (  # noqa: E402
 
 def _colpass_sblock() -> int:
     """Subgrids per einsum block: bounds the [Sb, F, xM, m] gather
-    transient while keeping the stage-2 contraction MXU-wide."""
+    transient. Default 256 covers every catalogue column in ONE block
+    (S <= 293 at 128k) — measured 13% faster than Sb=64 at 32k (the
+    lax.map blocks padded the short tail and serialized); the knob
+    remains for configs whose [S, F, xM, m] gather would not fit."""
     import os
 
-    return max(1, int(os.environ.get("SWIFTLY_COLPASS_SBLOCK", "64")))
+    return max(1, int(os.environ.get("SWIFTLY_COLPASS_SBLOCK", "256")))
 
 
 def _ceinsum(core, spec, a, b):
@@ -813,8 +817,9 @@ def _sampled_phases(core, residues):
 
 def _sampled_A_real(core, yB, dt, krows):
     """The sampled-DFT phase matrix pair (A_re, A_im) [R, yB] for real
-    facets — krows-dependent only, so group-scan callers hoist it out of
-    their slab loop."""
+    facets (krows-dependent only; factored from the pass body so a
+    caller that batches multiple slabs against one krows set can build
+    it once)."""
     import jax.numpy as jnp
 
     yN = core.yN_size
@@ -829,8 +834,7 @@ def _sampled_A_real(core, yB, dt, krows):
 def _sampled_apply_real(core, A_re, A_im, Fr, e0, krows):
     """Apply a prebuilt sampled phase matrix to a real facet slab
     [F, yB, yB] -> rows [F, R, yB, 2] (the per-facet e0 phase rotation
-    included). Single source for `_facet_pass_sampled_fn(real)` and the
-    whole-group fused program."""
+    included). The `_facet_pass_sampled_fn(real)` body."""
     import jax.numpy as jnp
 
     yN = core.yN_size
