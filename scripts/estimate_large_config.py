@@ -125,9 +125,10 @@ def main():
                     "single-chip + multi-host sizing, incl. 128k")
     ap.add_argument("--h2d_gibs", type=float, default=0.85,
                     help="measured h2d bandwidth for --plan_only")
-    ap.add_argument("--tflops", type=float, default=14.28,
+    ap.add_argument("--tflops", type=float, default=16.51,
                     help="measured sustained TF/s for --plan_only "
-                    "(default: the 64k streamed rate, BENCH_r04)")
+                    "(default: the 64k streamed einsum-colpass rate, "
+                    "BENCH_64k_streamed_r4)")
     ap.add_argument("--host_ram_gib", type=float, default=125.0,
                     help="host RAM for the multi-host threshold")
     args = ap.parse_args()

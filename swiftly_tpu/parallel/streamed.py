@@ -1938,7 +1938,7 @@ class StreamedForward:
                             grouped_col_group_for_budget(
                                 base, budget, len(col_offs0), S,
                                 subgrid_size, self._facets_real, Fg, c,
-                                slab_depth=depth,
+                                slab_depth=depth, warn=False,
                             ),
                         )
                     ),
@@ -1946,6 +1946,14 @@ class StreamedForward:
                 )
         chunk = min(chunk, G)
         G = max(1, (G // chunk) * chunk)
+        if not self.col_group and budget is not None:
+            # re-evaluate the SELECTED (post-clamp) pair with the
+            # warning armed: the sweep probed quietly, and warning for
+            # a chunk size that is never dispatched would cry wolf
+            grouped_col_group_for_budget(
+                base, budget, len(col_offs0), S, subgrid_size,
+                self._facets_real, Fg, chunk, slab_depth=depth,
+            )
         n_chunks = G // chunk
         colpass = _resolve_colpass(core, Fg)
         self.last_plan = {
@@ -2183,7 +2191,7 @@ def facet_stack_bytes(base, real=False):
 
 def grouped_col_group_for_budget(
     base, budget, n_cols, S, subgrid_size, real, facet_group, chunk,
-    slab_depth=2,
+    slab_depth=2, warn=True,
 ):
     """Largest column-group G for the facet-slab-streamed sampled path.
 
@@ -2192,7 +2200,9 @@ def grouped_col_group_for_budget(
     [S, xA, xA]. Flat: `slab_depth` facet slabs in flight (the upload
     pipeline; 1 at scales where two slabs alone overflow HBM), the
     per-chunk scan transients ([chunk, S, xM, xM] carry + prep1 rows),
-    and a trig/fragmentation reserve.
+    and a trig/fragmentation reserve. ``warn=False`` evaluates quietly —
+    the executor's (G, chunk) sweep probes chunks it may not select and
+    re-warns only for the chosen pair.
     """
     core = base.core
     dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
@@ -2229,7 +2239,7 @@ def grouped_col_group_for_budget(
     ) * dsize
     reserve = 0.6e9
     headroom = budget - slab_b - chunk_b - reserve
-    if headroom <= per_G:
+    if warn and headroom <= per_G:
         # a provably-unfittable plan must not proceed silently: the
         # minimum group still gets dispatched (fail-soft callers catch
         # the OOM and resize), but the operator is told why

