@@ -287,3 +287,34 @@ def test_memory_sampler_html_single_sample_and_escaping(tmp_path):
     assert "<circle" in html  # single point -> dot, not invisible polyline
     assert "a&lt;b &amp; c" in html
     assert "dev&lt;0&gt;" in html
+
+
+def test_colpass_resolution(monkeypatch):
+    """SWIFTLY_COLPASS / SWIFTLY_COLPASS_BWD resolution: auto picks
+    einsum for the forward, fft for the backward; explicit values win;
+    invalid values raise (never silently fall back)."""
+    from swiftly_tpu.ops.core import SwiftlyCore
+    from swiftly_tpu.utils.flops import (
+        colpass_mode,
+        resolve_colpass,
+        resolve_colpass_bwd,
+    )
+
+    core = SwiftlyCore(13.5625, 1024, 256, 512, backend="jax")
+    monkeypatch.delenv("SWIFTLY_COLPASS", raising=False)
+    monkeypatch.delenv("SWIFTLY_COLPASS_BWD", raising=False)
+    assert colpass_mode() == "auto"
+    assert resolve_colpass(core, 1) == "einsum"
+    assert resolve_colpass_bwd(core, 9) == "fft"
+    monkeypatch.setenv("SWIFTLY_COLPASS", "fft")
+    assert resolve_colpass(core, 9) == "fft"
+    assert resolve_colpass_bwd(core, 9) == "fft"
+    monkeypatch.setenv("SWIFTLY_COLPASS_BWD", "einsum")
+    assert resolve_colpass_bwd(core, 9) == "einsum"
+    monkeypatch.setenv("SWIFTLY_COLPASS", "einsumm")
+    with pytest.raises(ValueError, match="SWIFTLY_COLPASS"):
+        colpass_mode()
+    monkeypatch.setenv("SWIFTLY_COLPASS", "auto")
+    monkeypatch.setenv("SWIFTLY_COLPASS_BWD", "nope")
+    with pytest.raises(ValueError, match="SWIFTLY_COLPASS_BWD"):
+        resolve_colpass_bwd(core, 9)
