@@ -38,6 +38,9 @@ def main():
     ap.add_argument("--G", type=int, default=8, help="column group size")
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--bwd", action="store_true",
+                    help="also time the backward stages (group column "
+                    "pass + adjoint sampled fold, fold_group=2)")
     args = ap.parse_args()
 
     import jax
@@ -247,6 +250,92 @@ def main():
         "note": f"{len(col_offs0)} columns in {n_groups} groups of {G}; "
                 "the measured full-cover wall-clock "
                 "(docs/performance.md) should fall inside this bracket",
+    }), flush=True)
+
+    if not args.bwd:
+        return
+
+    # -- backward stages (the round trip's other half) --------------------
+    # free every forward-stage device buffer first: the fold's donated
+    # [F, yB, yB, 2] accumulator is 9.1 GiB at 32k and must not share
+    # HBM with the forward's group buffer / partials
+    buf = out = acc = fin = slab = None  # noqa: F841 - releases buffers
+
+    from swiftly_tpu.parallel.streamed import (
+        _bwd_sampled_fold_j,
+        _column_pass_bwd_group_j,
+    )
+    from swiftly_tpu.utils.flops import resolve_colpass_bwd
+
+    # reuse the forward executor's facet stack (same fcs -> same
+    # offsets as foffs0 above) and its real dtype
+    rdt = core._Fb.dtype
+    m1 = jnp.asarray(np.asarray(fwd.stack.masks1, rdt))
+    Gb = 2  # the bench's fold_group default
+    rng = np.random.default_rng(3)
+    sgs_dev = jnp.asarray(
+        rng.standard_normal((Gb, S, xA, xA, 2)), jnp.float32
+    )
+    so_b = jnp.asarray(
+        [[(sg.off0, sg.off1) for sg in by_col[o]] for o in grp[:Gb]]
+    )
+    bcol = _column_pass_bwd_group_j(core, yB)
+    dt_bcol, rows_g = timed(
+        bcol, sgs_dev, so_b, foffs0, foffs1, m1
+    )
+    bwd_mode = resolve_colpass_bwd(core, F)
+    prep = fft_flops(xM, xA) + fft_flops(xM, xM)
+    extract = F * (
+        fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+    )
+    col_fin = F * (fft_flops(yN, m) + 6 * m * yB)
+    bcol_flops = Gb * (S * (prep + extract) + col_fin)
+    emit("bwd-column", dt_bcol, bcol_flops,
+         bytes_touched=sgs_dev.nbytes + rows_g.nbytes,
+         note=f"{Gb}-column backward group pass ({bwd_mode} body): "
+              f"prepare + per-facet extract + axis-1 finish")
+
+    # adjoint sampled fold: rows [Gb, F, m, yB] -> [F, Gb*m, yB] with
+    # the PRODUCTION layout (moveaxis before the reshape — a plain
+    # reshape would scramble the facet/column association the krows
+    # indices assume)
+    rows = jnp.moveaxis(rows_g, 0, 1).reshape(
+        (F, Gb * m) + rows_g.shape[3:]
+    )
+    krows_b = jnp.asarray(sampled_row_indices(core, grp[:Gb]))
+    e0 = jnp.asarray(
+        (np.asarray(fwd.stack.offs0) - yB // 2).astype(np.int32)
+    )
+    foldfn = _bwd_sampled_fold_j(core)
+
+    def run_fold(_):
+        # the fold donates its accumulator (rebuild per rep); return
+        # only a checksum so the 9.1 GiB result never outlives the rep
+        a = jnp.zeros((F, yB, yB, 2), jnp.float32)
+        r = foldfn(a, rows, e0, krows_b)
+        s = jnp.sum(r)
+        del a, r
+        return s
+
+    dt_fold, _ = timed(run_fold, 0)
+    R = Gb * m
+    fold_flops = 8 * R * yB * F * yB + 6 * F * R * yB
+    emit("bwd-fold", dt_fold, fold_flops,
+         bytes_touched=rows.nbytes + 2 * F * yB * yB * 4 * 2,
+         note=f"adjoint sampled einsum, K={R} rows -> [F, yB, yB] "
+              "image accumulator (includes the zeros rebuild)")
+    n_folds = -(-len(col_offs0) // Gb)
+    print(json.dumps({
+        "stage": "bwd-model",
+        "full_cover_lower_s": round(
+            n_folds * (dt_bcol + dt_fold - 2 * t_lat), 2
+        ),
+        "full_cover_upper_s": round(
+            n_folds * (dt_bcol + dt_fold + 2 * t_lat), 2
+        ),
+        "note": f"{len(col_offs0)} columns in {n_folds} fold groups of "
+                f"{Gb}; the round trip adds this to the forward model "
+                "above (plus the final facet finish)",
     }), flush=True)
 
 

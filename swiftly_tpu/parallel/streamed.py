@@ -68,7 +68,6 @@ from ..ops.core import (
     prepare_facet_math,
 )
 from .batched import (
-    _split_accumulate_fn,
     _mask_along,
     facet_contrib_to_subgrid,
     finish_masked_subgrid,
@@ -526,6 +525,38 @@ def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
     )
 
 
+def _bwd_scatter_rows(core, Z, sg_offs, axis_name=None):
+    """One column's per-subgrid contribution blocks [S, F, m, m(,2)] ->
+    the NAF_MNAF accumulator [F, m, yN(,2)] with ONE scatter-add.
+
+    Replaces the per-subgrid lax.scan whose [F, m, yN] carry (302 MB at
+    32k) crossed HBM once per subgrid — measured 2.9% of the matmul
+    ceiling for the whole backward column pass (scripts/roofline.py
+    --bwd). The destination index of block row j for subgrid offset
+    scaled is (yN//2 - m//2 + scaled + ((j - scaled) mod m)) mod yN —
+    the roll+wrapped-embed of `add_to_facet_math` as one index map
+    (the same window arithmetic as `sampled_row_indices`); duplicate
+    indices (overlapping windows) accumulate in the scatter.
+    """
+    import jax.numpy as jnp
+
+    m, yN = core.xM_yN_size, core.yN_size
+    S = Z.shape[0]
+    F = Z.shape[1]
+    scaled = sg_offs[:, 1] * yN // core.N  # [S]
+    j = jnp.arange(m)
+    idx = (
+        yN // 2 - m // 2 + scaled[:, None]
+        + jnp.mod(j[None, :] - scaled[:, None], m)
+    ) % yN  # [S, m]
+    Zm = jnp.moveaxis(Z, 0, 2)  # [F, m, S, m(,2)]
+    Zm = Zm.reshape((F, m, S * m) + Z.shape[4:])
+    zeros = jnp.zeros((F, m, yN) + Z.shape[4:], dtype=Z.dtype)
+    if axis_name is not None:
+        zeros = varying(zeros, axis_name)
+    return zeros.at[:, :, idx.reshape(-1)].add(Zm)
+
+
 def _bwd_colpass_operators(core, foffs0, foffs1):
     """Backward (adjoint) column-pass operators, built in-trace from an
     identity block.
@@ -571,16 +602,10 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
     import jax.numpy as jnp
 
     p = core._p
-    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+    xM = core.xM_size
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
-        F = foffs0.shape[0]
         E0, E1 = _bwd_colpass_operators(core, foffs0, foffs1)
-        zeros = jnp.zeros(
-            (F, m, yN) + subgrids.shape[3:], dtype=subgrids.dtype
-        )
-        if axis_name is not None:
-            zeros = varying(zeros, axis_name)
 
         def emb_one(sg, so):
             x = p.wrapped_embed(sg, xM, so[0], 0)
@@ -592,7 +617,7 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
         pad = nb * Sb - S
         sg_p, so_p = subgrids, sg_offs
         if pad:
-            # zero-padded subgrids contribute exactly nothing to the fold
+            # zero-padded subgrids contribute exactly nothing
             zpad = jnp.zeros(
                 (pad,) + subgrids.shape[1:], dtype=subgrids.dtype
             )
@@ -600,26 +625,26 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
             so_p = jnp.concatenate(
                 [sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)]
             )
-        sg_b = sg_p.reshape((nb, Sb) + sg_p.shape[1:])
-        so_b = so_p.reshape((nb, Sb) + so_p.shape[1:])
 
-        def block_fold(acc, xs):
+        def block(xs):
             sg_blk, so_blk = xs
             emb = jax.vmap(emb_one)(sg_blk, so_blk)  # [Sb, xM, xM(,2)]
             Y = _ceinsum(core, "fia,sab->sfib", E0, emb)
-            Z = _ceinsum(core, "sfib,fbj->sfij", Y, E1)  # [Sb, F, m, m]
+            return _ceinsum(core, "sfib,fbj->sfij", Y, E1)  # [Sb,F,m,m]
 
-            def fold(a2, ys):
-                z, so = ys
-                return (
-                    a2 + add_to_facet_math(p, yN, core.N, z, so[1], 2),
-                    None,
-                )
-
-            acc, _ = jax.lax.scan(fold, acc, (Z, so_blk))
-            return acc, None
-
-        acc, _ = jax.lax.scan(block_fold, zeros, (sg_b, so_b))
+        if nb == 1:
+            Z = block((sg_p, so_p))
+        else:
+            Z = jax.lax.map(
+                block,
+                (
+                    sg_p.reshape((nb, Sb) + sg_p.shape[1:]),
+                    so_p.reshape((nb, Sb) + so_p.shape[1:]),
+                ),
+            )
+            Z = Z.reshape((nb * Sb,) + Z.shape[2:])
+        # padded rows are zero blocks: the scatter adds nothing for them
+        acc = _bwd_scatter_rows(core, Z, so_p, axis_name)
 
         def fin(a, off1, m1):
             x = finish_facet_math(p, core._Fb, facet_size, a, off1, 1)
@@ -652,22 +677,31 @@ def _column_pass_bwd_fn(core, facet_size, axis_name=None):
 
 
 def _column_pass_bwd_fft_fn(core, facet_size, axis_name=None):
-    """The per-facet fft-chain backward column pass."""
+    """The per-facet fft-chain backward column pass: batched prepare +
+    per-(subgrid, facet) extract chains, then ONE scatter-add into the
+    accumulator layout. (The previous per-subgrid `lax.scan` fold moved
+    the [F, m, yN] carry through HBM once per subgrid — 2.9% of the
+    matmul ceiling, the slowest stage in the whole pipeline; the [S, F,
+    m, m] contribution stack is only ~350 MB at 32k, so materialising
+    it and scattering once is strictly better.)"""
+    from ..ops.core import prepare_subgrid_math
+    from .batched import subgrid_contrib_to_facet
+
     p = core._p
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
-        F = foffs0.shape[0]
-        zeros = jax.numpy.zeros(
-            (F, core.xM_yN_size, core.yN_size) + subgrids.shape[3:],
-            dtype=subgrids.dtype,
-        )
-        if axis_name is not None:
-            # scan carry must be tagged shard-varying (its updates mix in
-            # the facet-sharded offsets)
-            zeros = varying(zeros, axis_name)
-        NAF_MNAFs = _split_accumulate_fn(
-            core, subgrids, sg_offs, (foffs0, foffs1), zeros
-        )
+        def prep_one(sg, so):
+            return prepare_subgrid_math(p, core.xM_size, sg, so)
+
+        prepped = jax.vmap(prep_one)(subgrids, sg_offs)  # [S, xM, xM]
+
+        def per_sg(pp):
+            return jax.vmap(
+                lambda f0, f1: subgrid_contrib_to_facet(core, pp, f0, f1)
+            )(foffs0, foffs1)  # [F, m, m(,2)]
+
+        Z = jax.vmap(per_sg)(prepped)  # [S, F, m, m(,2)]
+        NAF_MNAFs = _bwd_scatter_rows(core, Z, sg_offs, axis_name)
 
         def fin(acc, off1, m1):
             x = finish_facet_math(p, core._Fb, facet_size, acc, off1, 1)
