@@ -50,6 +50,26 @@ import numpy as np
 log = logging.getLogger("bench")
 
 
+def _bench_sources(N):
+    """Point sources SPREAD across the whole image (centre-relative,
+    fractions of N), so every subgrid column band carries nontrivial
+    signal and the oracle RMS check has power everywhere.
+
+    A single source at the origin leaves far columns at ~1e-17 PSWF-tail
+    amplitudes — which is how the r4 128k artifact failed to detect an
+    int32 offset-scaling overflow that extracted half the cover's columns
+    from the wrong window (see ops.core.scaled_offset).
+    """
+    fr = [
+        (-0.41, -0.37), (-0.23, 0.11), (-0.05, 0.43), (0.02, -0.19),
+        (0.17, 0.31), (0.29, -0.45), (0.36, 0.07), (0.44, -0.02),
+    ]
+    return [
+        (1.0 + 0.25 * k, int(a * N), int(b * N))
+        for k, (a, b) in enumerate(fr)
+    ]
+
+
 def _build(backend, params, dtype=None, streamed=False):
     from swiftly_tpu import (
         SwiftlyConfig,
@@ -62,7 +82,7 @@ def _build(backend, params, dtype=None, streamed=False):
     config = SwiftlyConfig(backend=backend, dtype=dtype, **params)
     facet_configs = make_full_facet_cover(config)
     subgrid_configs = make_full_subgrid_cover(config)
-    sources = [(1.0, 1, 0)]
+    sources = _bench_sources(config.image_size)
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
@@ -471,54 +491,77 @@ def run_one(config_name, mode):
         per_el = np.dtype(core.dtype).itemsize * (
             2 if core.backend == "planar" else 1
         )
-        F_total = fwd.stack.n_total
-        acc_bytes = F_total * yB * yB * per_el
+        F_total = len(facet_configs)
+        per_facet_acc = yB * yB * per_el
+        per_facet_rows = core.xM_yN_size * yB * per_el
 
-        def _set_headroom():
-            row_bytes = F_total * core.xM_yN_size * yB * per_el
+        def _per_facet_resident():
             # accumulator + live column rows (fold_group pending + 2 in
             # flight, bounded by the backward's rows checksum pipeline)
-            # + the fold's phase-rotated copies and bounded row-block
-            # transients
+            return per_facet_acc + (
+                2 * fold_group[0] + 2
+            ) * per_facet_rows
+
+        # Facet-partitioned backward: the 64k+ accumulator (34 GiB at
+        # 64k) cannot fit 16 GiB HBM whole, but the backward column pass
+        # AND the adjoint fold both scale with the facets in the
+        # program, so P passes over facet subsets do the SAME total
+        # backward work — only the forward (which must replay every
+        # subgrid column for each pass) repeats. Pass sizing: largest
+        # per-pass facet count whose accumulator + row pipeline leaves
+        # the forward its minimum streaming working set.
+        from swiftly_tpu.utils.profiling import probe_hbm_bytes
+
+        env_hbm = os.environ.get("SWIFTLY_HBM_BUDGET")
+        budget = (
+            float(env_hbm)
+            if env_hbm
+            else (probe_hbm_bytes() or None)
+        )
+        fwd_min = 3.3e9  # measured: the 32k roundtrip fwd plan (G=3,
+        # slab_depth=2) streams green inside this
+        reserve = 1.2e9  # fold row-blocks + donation-copy slack
+        n_env = int(os.environ.get("BENCH_BWD_FACET_PASSES", "0"))
+        if n_env:
+            n_parts = min(n_env, F_total)
+        elif budget is None:
+            n_parts = 1
+        else:
+            usable = budget - fwd_min - reserve
+            F_sub = max(1, int(usable // _per_facet_resident()))
+            n_parts = -(-F_total // F_sub)
+        # equal-size parts minimise distinct jit shapes (one extra
+        # compile per distinct per-pass facet count)
+        F_sub = -(-F_total // n_parts)
+        parts = [
+            (i, min(i + F_sub, F_total))
+            for i in range(0, F_total, F_sub)
+        ]
+
+        def _set_headroom():
+            # no mesh in the bench, so each part's _FacetStack has
+            # n_total == n_real and the raw part size IS the allocated
+            # accumulator's facet count (a meshed caller would need the
+            # padded count here)
             fwd.hbm_headroom = int(
-                acc_bytes
-                + (2 * fold_group[0] + 2) * row_bytes
-                + 1.2e9  # fold row-blocks + donation-copy slack
+                max(i1 - i0 for i0, i1 in parts) * _per_facet_resident()
+                + reserve
             )
 
         _set_headroom()
 
-        def run_roundtrip_streamed():
-            """StreamedForward -> sampled-residency StreamedBackward,
-            entirely on device: forward columns feed the backward's
-            adjoint-einsum accumulator, the finished facets are compared
-            on device with the forward's own resident facet planes (the
-            round trip must reproduce its input), and one scalar pull
-            forces completion of the whole graph."""
-            _set_headroom()
-            bwd = StreamedBackward(
-                config, facet_configs, residency="sampled",
-                fold_group=fold_group[0],
-            )
-            # group feeding: one vmapped column pass + one fold per
-            # forward column group (per-column feeding pays the
-            # per-dispatch tunnel latency 2G+ times per group)
-            for per_col, group in fwd.stream_column_groups(
-                subgrid_configs
-            ):
-                bwd.add_subgrid_group(
-                    [[sg for _, sg in col] for col in per_col], group
-                )
-            facets_dev = bwd.finish_device()
-            n_real = fwd.stack.n_real
+        def _verify_part(facets_dev, i0, i1):
+            """Device-side RMS of reproduced facets [i0:i1) vs the round
+            trip's own inputs; returns per-facet mean |res|^2."""
+            n = i1 - i0
             if fwd._dev_facets is not None and fwd._facets_real:
                 ref = fwd._dev_facets[0]
-                res_re = facets_dev[:n_real, :, :, 0] - ref[:n_real]
-                res_im = facets_dev[:n_real, :, :, 1]
-                rms2 = jnp.mean(
+                res_re = facets_dev[:n, :, :, 0] - ref[i0:i1]
+                res_im = facets_dev[:n, :, :, 1]
+                return jnp.mean(
                     res_re * res_re + res_im * res_im, axis=(1, 2)
                 )
-            elif getattr(fwd, "_facets_sparse", False):
+            if getattr(fwd, "_facets_sparse", False):
                 # grouped sparse forward: synthesise each reference
                 # plane on device (no multi-GB re-upload). Pull each
                 # iteration's scalar before dispatching the next — the
@@ -526,10 +569,10 @@ def run_one(config_name, mode):
                 # live at once (async dispatch; block_until_ready is
                 # not completion on this runtime).
                 rms2s = []
-                for i in range(n_real):
+                for i in range(i0, i1):
                     ref = fwd.synth_facet_device(i)
-                    res_re = facets_dev[i, :, :, 0] - ref
-                    res_im = facets_dev[i, :, :, 1]
+                    res_re = facets_dev[i - i0, :, :, 0] - ref
+                    res_im = facets_dev[i - i0, :, :, 1]
                     rms2s.append(
                         float(
                             np.asarray(
@@ -537,26 +580,60 @@ def run_one(config_name, mode):
                             )
                         )
                     )
-                rms2 = jnp.asarray(rms2s)
-            else:
-                # re-upload per-facet references (grouped forward or
-                # complex facets: no resident copy to compare against)
-                rms2s = []
-                for i in range(n_real):
-                    ref = jnp.asarray(
-                        fwd._facet_data[i]
-                        if not fwd._facets_real
-                        else np.stack(
-                            [fwd._facet_data[i],
-                             np.zeros_like(fwd._facet_data[i])],
-                            axis=-1,
-                        )
+                return jnp.asarray(rms2s)
+            # re-upload per-facet references (grouped forward or
+            # complex facets: no resident copy to compare against)
+            rms2s = []
+            for i in range(i0, i1):
+                ref = jnp.asarray(
+                    fwd._facet_data[i]
+                    if not fwd._facets_real
+                    else np.stack(
+                        [fwd._facet_data[i],
+                         np.zeros_like(fwd._facet_data[i])],
+                        axis=-1,
                     )
-                    rms2s.append(
-                        _rms2_device(config.core, facets_dev[i], ref)
+                )
+                rms2s.append(
+                    _rms2_device(config.core, facets_dev[i - i0], ref)
+                )
+            return jnp.stack(rms2s)
+
+        def run_roundtrip_streamed():
+            """StreamedForward -> sampled-residency StreamedBackward,
+            entirely on device: forward columns feed the backward's
+            adjoint-einsum accumulator, the finished facets are compared
+            on device with the round trip's own input facets, and one
+            scalar pull forces completion of the whole graph. When the
+            full-facet accumulator exceeds HBM, the backward runs in
+            facet-subset passes (same total backward work; the forward
+            replays per pass)."""
+            _set_headroom()
+            max_rms2 = 0.0
+            for kpart, (i0, i1) in enumerate(parts):
+                bwd = StreamedBackward(
+                    config, list(facet_configs[i0:i1]),
+                    residency="sampled", fold_group=fold_group[0],
+                )
+                # group feeding: one vmapped column pass + one fold per
+                # forward column group (per-column feeding pays the
+                # per-dispatch tunnel latency 2G+ times per group)
+                for per_col, group in fwd.stream_column_groups(
+                    subgrid_configs
+                ):
+                    bwd.add_subgrid_group(
+                        [[sg for _, sg in col] for col in per_col], group
                     )
-                rms2 = jnp.stack(rms2s)
-            return float(np.asarray(jnp.max(rms2))) ** 0.5
+                facets_dev = bwd.finish_device()
+                rms2 = _verify_part(facets_dev, i0, i1)
+                max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
+                del facets_dev, bwd
+                if len(parts) > 1:
+                    log.info(
+                        "roundtrip pass %d/%d (facets %d:%d) done",
+                        kpart + 1, len(parts), i0, i1,
+                    )
+            return max_rms2 ** 0.5
 
         t0 = time.time()
         warm_rms = _oom_soft(

@@ -284,12 +284,21 @@ def main():
         bcol, sgs_dev, so_b, foffs0, foffs1, m1
     )
     bwd_mode = resolve_colpass_bwd(core, F)
-    prep = fft_flops(xM, xA) + fft_flops(xM, xM)
-    extract = F * (
-        fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
-    )
     col_fin = F * (fft_flops(yN, m) + 6 * m * yB)
-    bcol_flops = Gb * (S * (prep + extract) + col_fin)
+    if bwd_mode == "einsum":
+        # the einsum body's FLOP shape (matches
+        # utils.flops.backward_sampled_flops): two K=xM complex einsums
+        # per (subgrid, facet) + the scatter-add — NOT the fft-chain
+        # formulas, which would describe a different algorithm than the
+        # one timed
+        per_sg = F * 8 * (m * xM * xM + m * m * xM) + F * 2 * m * yN
+        bcol_flops = Gb * (S * per_sg + col_fin)
+    else:
+        prep = fft_flops(xM, xA) + fft_flops(xM, xM)
+        extract = F * (
+            fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+        )
+        bcol_flops = Gb * (S * (prep + extract) + col_fin)
     emit("bwd-column", dt_bcol, bcol_flops,
          bytes_touched=sgs_dev.nbytes + rows_g.nbytes,
          note=f"{Gb}-column backward group pass ({bwd_mode} body): "

@@ -72,6 +72,34 @@ def validate_core_params(N: int, xM_size: int, yN_size: int) -> None:
 # ---------------------------------------------------------------------------
 
 
+def scaled_offset(off, num, N):
+    """``floor((off mod N) * num / N)`` — int32-overflow-safe.
+
+    Offsets are traced int32 inside jitted programs (jax silently keeps
+    int32 without x64), and the direct product ``off * num`` overflows
+    once it crosses 2**31 — at the 128k catalogue scale
+    (off1 ~ 1.3e5 x yN 6.5e4 = 8.6e9) the wrapped product lands the
+    extraction window 2**15 positions away from the true one (measured;
+    undetectable with a single-point-source model whose far columns are
+    ~1e-17 tails either way). Reducing ``off`` mod N first is exact
+    because the result is only ever consumed mod ``num`` (shifts of
+    period-``num`` windows), and the staged 8-bit-limb divmod bounds the
+    partial products by ``(N >> 8) * num`` (the ``hi * num`` term; the
+    recombination term is below ``2**8 * (N + num)``) — asserted below,
+    and true with an order of magnitude to spare for the whole catalogue
+    (128k: (2**17 >> 8) * 2**16 = 2**25).
+
+    Works for python ints, numpy int64 and traced int32 alike (pure
+    ``>> & // %`` arithmetic).
+    """
+    assert (N >> 8) * num < 1 << 31 and (N + num) << 8 < 1 << 31, (N, num)
+    r = off % N
+    hi, lo = r >> 8, r & 0xFF
+    t = hi * num
+    q1, r1 = t // N, t % N
+    return (q1 << 8) + ((r1 << 8) + lo * num) // N
+
+
 def prepare_facet_math(p, Fb, yN_size, facet, facet_off, axis):
     """Correct facet by Fb, embed at its offset in the padded frame, iFFT.
 
@@ -92,7 +120,7 @@ def extract_from_facet_math(p, xM_yN_size, N, yN_size, prep_facet, subgrid_off, 
     between a facet and a subgrid. Parity: reference ``extract_from_facet``
     (``core.py:224-253``).
     """
-    scaled = subgrid_off * yN_size // N
+    scaled = scaled_offset(subgrid_off, yN_size, N)
     window = p.wrapped_extract(prep_facet, xM_yN_size, scaled, axis)
     return p.roll_axis(window, scaled, axis)
 
@@ -106,7 +134,7 @@ def add_to_subgrid_math(p, Fn, xM_size, N, contrib, facet_off, axis):
     Parity: reference ``add_to_subgrid`` (``core.py:255-285``), with the
     accumulation (`out`/add_mode) lifted to the caller.
     """
-    scaled = facet_off * xM_size // N
+    scaled = scaled_offset(facet_off, xM_size, N)
     spectrum = p.roll_axis(p.fft(contrib, axis), -scaled, axis)
     windowed = spectrum * p.broadcast_along(Fn, p.ndim(contrib), axis)
     return p.wrapped_embed(windowed, xM_size, scaled, axis)
@@ -141,7 +169,7 @@ def extract_from_subgrid_math(p, Fn, xM_yN_size, xM_size, N, prep_subgrid, facet
 
     Parity: reference ``extract_from_subgrid`` (``core.py:370-406``).
     """
-    scaled = facet_off * xM_size // N
+    scaled = scaled_offset(facet_off, xM_size, N)
     window = p.wrapped_extract(prep_subgrid, xM_yN_size, scaled, axis)
     windowed = window * p.broadcast_along(Fn, p.ndim(window), axis)
     return p.ifft(p.roll_axis(windowed, scaled, axis), axis)
@@ -153,7 +181,7 @@ def add_to_facet_math(p, yN_size, N, contrib, subgrid_off, axis):
     Linear; sum over subgrids in any order. Parity: reference
     ``add_to_facet`` (``core.py:408-449``) with accumulation lifted out.
     """
-    scaled = subgrid_off * yN_size // N
+    scaled = scaled_offset(subgrid_off, yN_size, N)
     centred = p.roll_axis(contrib, -scaled, axis)
     return p.wrapped_embed(centred, yN_size, scaled, axis)
 

@@ -540,10 +540,12 @@ def _bwd_scatter_rows(core, Z, sg_offs, axis_name=None):
     """
     import jax.numpy as jnp
 
+    from ..ops.core import scaled_offset
+
     m, yN = core.xM_yN_size, core.yN_size
     S = Z.shape[0]
     F = Z.shape[1]
-    scaled = sg_offs[:, 1] * yN // core.N  # [S]
+    scaled = scaled_offset(sg_offs[:, 1], yN, core.N)  # [S]
     j = jnp.arange(m)
     idx = (
         yN // 2 - m // 2 + scaled[:, None]
@@ -687,21 +689,73 @@ def _column_pass_bwd_fft_fn(core, facet_size, axis_name=None):
     from ..ops.core import prepare_subgrid_math
     from .batched import subgrid_contrib_to_facet
 
+    import jax.numpy as jnp
+
     p = core._p
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
         def prep_one(sg, so):
             return prepare_subgrid_math(p, core.xM_size, sg, so)
 
-        prepped = jax.vmap(prep_one)(subgrids, sg_offs)  # [S, xM, xM]
-
         def per_sg(pp):
             return jax.vmap(
                 lambda f0, f1: subgrid_contrib_to_facet(core, pp, f0, f1)
             )(foffs0, foffs1)  # [F, m, m(,2)]
 
-        Z = jax.vmap(per_sg)(prepped)  # [S, F, m, m(,2)]
-        NAF_MNAFs = _bwd_scatter_rows(core, Z, sg_offs, axis_name)
+        def block_z(sg_b, so_b):
+            prepped = jax.vmap(prep_one)(sg_b, so_b)  # [Sb, xM, xM]
+            return jax.vmap(per_sg)(prepped)  # [Sb, F, m, m(,2)]
+
+        # the [S, F, m, m] contribution stack is blocked by Sb like the
+        # einsum body's gather transient; Sb is rebalanced to ceil(S/nb)
+        # so the zero-pad never exceeds nb-1 rows (a raw 256-block split
+        # of S=293 would pad 219 dead rows — 1.75x the stage's FLOPs)
+        S = sg_offs.shape[0]
+        Sb = min(_colpass_sblock(), S)
+        nb = -(-S // Sb)
+        Sb = -(-S // nb)
+        if nb == 1:
+            NAF_MNAFs = _bwd_scatter_rows(
+                core, block_z(subgrids, sg_offs), sg_offs, axis_name
+            )
+        else:
+            pad = nb * Sb - S
+            sg_p, so_p = subgrids, sg_offs
+            if pad:
+                # zero-padded subgrids scatter exactly nothing
+                sg_p = jnp.concatenate(
+                    [subgrids,
+                     jnp.zeros((pad,) + subgrids.shape[1:], subgrids.dtype)]
+                )
+                so_p = jnp.concatenate(
+                    [sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)]
+                )
+
+            def fold(acc, xs):
+                sg_b, so_b = xs
+                return (
+                    acc
+                    + _bwd_scatter_rows(
+                        core, block_z(sg_b, so_b), so_b, axis_name
+                    ),
+                    None,
+                )
+
+            F = foffs0.shape[0]
+            init = jnp.zeros(
+                (F, core.xM_yN_size, core.yN_size) + subgrids.shape[3:],
+                dtype=subgrids.dtype,
+            )
+            if axis_name is not None:
+                init = varying(init, axis_name)
+            NAF_MNAFs, _ = jax.lax.scan(
+                fold,
+                init,
+                (
+                    sg_p.reshape((nb, Sb) + sg_p.shape[1:]),
+                    so_p.reshape((nb, Sb) + so_p.shape[1:]),
+                ),
+            )
 
         def fin(acc, off1, m1):
             x = finish_facet_math(p, core._Fb, facet_size, acc, off1, 1)
@@ -2594,8 +2648,12 @@ class StreamedBackward:
         cap = max(1, int(self._fold_group))
         G = len(offs)
         for j in range(0, G, cap):
-            while len(self._rows_inflight) >= 2:
-                np.asarray(self._rows_inflight.popleft())
+            # no separate rows checksum here: each chunk's fold consumes
+            # its rows immediately, so the fold pipeline's depth-2 pull
+            # (_fold_rows) transitively bounds live rows to two chunks'
+            # worth — a separate rows pull would add one ~0.1 s tunnel
+            # round trip per chunk for backpressure the fold already
+            # provides (37 chunks = ~4 s of the 32k backward leg)
             rows = colfn(
                 jnp.asarray(subgrids_group[j : j + cap]),
                 jnp.asarray(sg_offs_np[j : j + cap]),
@@ -2603,7 +2661,6 @@ class StreamedBackward:
                 base._foffs1,
                 base._masks1_dev,
             )  # [g, F, m, yB(,2)]
-            self._rows_inflight.append(jnp.sum(rows[:, :, 0]))
             rows_cat = jnp.moveaxis(rows, 0, 1).reshape(
                 (rows.shape[1], rows.shape[0] * rows.shape[2])
                 + rows.shape[3:]

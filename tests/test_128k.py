@@ -15,6 +15,66 @@ import numpy as np
 import pytest
 
 from swiftly_tpu.parallel.streamed import _mulmod, sampled_row_indices
+from swiftly_tpu.ops.core import scaled_offset
+
+
+def test_scaled_offset_exact_in_traced_int32():
+    """floor(off*num/N) via the staged-limb helper == int64 ground truth
+    for traced int32 offsets, ACROSS the band where the direct product
+    overflows 2**31 (off1 in [32768, 98304) at 128k: off*yN up to 8.6e9).
+
+    Regression: the direct product placed the extraction window 2**15
+    positions off for half the 128k cover's columns — undetectable by a
+    single-point-source bench whose far columns are ~1e-17 tails.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    N, yN = 131072, 65536
+    rng = np.random.default_rng(1)
+    offs = np.concatenate(
+        [
+            rng.integers(0, N, size=8192),
+            [0, 1, 32767, 32768, 40000, 65535, 65536, 98303, 98304, N - 1],
+        ]
+    ).astype(np.int32)
+    got = np.asarray(
+        jax.jit(lambda o: scaled_offset(o, yN, N))(jnp.asarray(offs))
+    )
+    want = offs.astype(np.int64) * yN // N
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+    # the direct traced product really is wrong here (guards against the
+    # test silently passing on an x64-enabled runtime)
+    if not jax.config.jax_enable_x64:
+        direct = np.asarray(
+            jax.jit(lambda o: o * yN // N)(jnp.asarray(offs))
+        )
+        assert (direct.astype(np.int64) != want).any()
+
+
+def test_extract_from_facet_exact_in_overflow_band():
+    """Traced extract_from_facet_math at 128k geometry (off1=40000, the
+    overflow band) == the numpy backend evaluated with exact host ints."""
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu.ops import numpy_backend as npk
+    from swiftly_tpu.ops import primitives as jxk
+    from swiftly_tpu.ops.core import extract_from_facet_math
+
+    N, yN, m = 131072, 65536, 256
+    rng = np.random.default_rng(2)
+    H = rng.standard_normal((2, yN)).astype(np.complex64)
+    for off in (40000, 70002, 98302):
+        got = np.asarray(
+            jax.jit(
+                lambda o, off=off: extract_from_facet_math(
+                    jxk, m, N, yN, jnp.asarray(H), o, 1
+                )
+            )(jnp.int32(off))
+        )
+        want = extract_from_facet_math(npk, m, N, yN, H, off, 1)
+        np.testing.assert_allclose(got, want, rtol=0, atol=0)
 
 
 class _GeomCore:
