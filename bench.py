@@ -70,19 +70,45 @@ def _bench_sources(N):
     ]
 
 
-def _build(backend, params, dtype=None, streamed=False):
+def _build(backend, params, dtype=None, streamed=False, sparse_fov=None):
     from swiftly_tpu import (
         SwiftlyConfig,
         SwiftlyForward,
         make_full_facet_cover,
         make_full_subgrid_cover,
         make_facet,
+        make_sparse_facet_cover,
+        sparse_fov_cover_offsets,
     )
 
     config = SwiftlyConfig(backend=backend, dtype=dtype, **params)
-    facet_configs = make_full_facet_cover(config)
+    if sparse_fov:
+        # circular-FoV sparse facet cover (the reference's
+        # demo_sparse_facet shape): facets exist only where the FoV
+        # needs them; sources are scaled into the covered circle so the
+        # sparse cover represents the whole sky model exactly
+        fov_pixels = int(config.image_size * sparse_fov)
+        offsets, masks = sparse_fov_cover_offsets(config, fov_pixels)
+        facet_configs = make_sparse_facet_cover(
+            config.max_facet_size, offsets, masks
+        )
+        lim_frac = max(
+            sparse_fov / 2
+            - config.max_facet_size / (2 * config.image_size),
+            4 / config.image_size,
+        )
+        # rescale by the spread set's max RADIUS (sqrt(.41^2+.37^2) =
+        # 0.553) so every source lands inside the circle of covered
+        # facet CENTRES — bounding per-coordinate instead lets corner
+        # sources escape the cover (reported as oracle RMS failures)
+        sources = [
+            (w, int(r * lim_frac / 0.56), int(c * lim_frac / 0.56))
+            for (w, r, c) in _bench_sources(config.image_size)
+        ]
+    else:
+        facet_configs = make_full_facet_cover(config)
+        sources = _bench_sources(config.image_size)
     subgrid_configs = make_full_subgrid_cover(config)
-    sources = _bench_sources(config.image_size)
     if streamed:
         from swiftly_tpu.parallel import StreamedForward
 
@@ -129,20 +155,31 @@ def _build(backend, params, dtype=None, streamed=False):
 
 
 def _oracle_sample_stack(config, subgrid_configs, sources, min_n=100,
-                         target_pct=2.0):
+                         target_pct=2.0, max_bytes=3e8):
     """Device-resident oracle subgrids for >= max(min_n, target_pct%) of
     the cover, spread evenly, + the index map.
 
     The accuracy check at 32k+ scale: residuals are computed ON DEVICE
     against these uploaded references (d2h on tunnel-attached chips runs
     at ~10 MB/s, so pulling subgrids to compare host-side would dominate
-    the benchmark)."""
+    the benchmark). The stack is capped at `max_bytes` residency: the
+    uncapped 2% of the 128k cover was 2.57 GiB of HBM, which alone
+    forced the column-group search from G=2 down to the dispatch-bound
+    G=1 plan (the r4 128k run's 10.1% MFU); 300 MB still spreads samples
+    over every column band, and the multi-point-source model gives every
+    band real signal to check."""
     import jax.numpy as jnp
 
     from swiftly_tpu import make_subgrid
 
+    core0 = config.core
+    sg_bytes = subgrid_configs[0].size ** 2 * (
+        np.dtype(core0.dtype).itemsize
+        * (2 if core0.backend == "planar" else 1)
+    )
     n = len(subgrid_configs)
     n_s = min(n, max(min_n, int(n * target_pct / 100)))
+    n_s = max(1, min(n_s, int(max_bytes // sg_bytes)))
     stride = max(1, n // n_s)
     idxs = list(range(0, n, stride))
     t0 = time.time()
@@ -163,6 +200,39 @@ def _oracle_sample_stack(config, subgrid_configs, sources, min_n=100,
     log.info("oracle sample stack: %d subgrids (%.2f GiB) in %.1fs",
              len(idxs), stack.nbytes / 2**30, time.time() - t0)
     return {i: k for k, i in enumerate(idxs)}, stack
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_rms2_fn(Cr, yB):
+    """Jitted per-row-chunk |dev - sparse_ref|^2 sum: synthesises the
+    reference rows [j0, j0+Cr) by scattering the point-source pixels
+    (out-of-chunk pixels drop), so no full [yB, yB] reference plane ever
+    materialises next to the live accumulator. Cached so facet-partition
+    passes share ONE compile."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fn(dev, r, c, v, j0):
+        chunk = jax.lax.dynamic_slice(
+            dev, (j0, jnp.int32(0), jnp.int32(0)), (Cr, yB, 2)
+        )
+        # rows below the chunk must be remapped to a POSITIVE
+        # out-of-bounds index: negative traced indices wrap numpy-style
+        # (mode="drop" only discards past-the-end), which double-placed
+        # every pixel into the following chunk
+        rr = jnp.where((r >= j0) & (r < j0 + Cr), r - j0, Cr)
+        ref = jnp.zeros((Cr, yB), chunk.dtype).at[rr, c].add(
+            v, mode="drop"
+        )
+        res_re = chunk[..., 0] - ref
+        res_im = chunk[..., 1]
+        return jnp.sum(res_re * res_re + res_im * res_im)
+
+    return fn
 
 
 def _rms2_device(core, got, want):
@@ -240,7 +310,7 @@ def _oom_soft(run, fwd, extra, fold_group=None, retries=2):
             gc.collect()
 
 
-def _numpy_baseline_from_parts(params, sources):
+def _numpy_baseline_from_parts(params, sources, reps=3):
     """Extrapolate the numpy forward wall-clock from sampled sub-ops.
 
     At streamed-mode scales (32k+) a full numpy forward pass takes hours
@@ -248,6 +318,12 @@ def _numpy_baseline_from_parts(params, sources):
     scale linearly in op COUNTS (never in config size): facet preparation
     per column block, per-column extraction+preparation, and per-subgrid
     summation/finish.
+
+    Each centre is warmed once (cold first calls carry FFT planning and
+    allocator noise — the r4 estimates spread 4x run-to-run) and then
+    timed `reps` times; returns ``(low, high)`` totals built from the
+    per-centre min / median. Callers report the bracket and use its low
+    end for vs_baseline (under-, never over-stating the speedup).
     """
     from swiftly_tpu import (
         SwiftlyConfig,
@@ -267,29 +343,45 @@ def _numpy_baseline_from_parts(params, sources):
     m, yN = core.xM_yN_size, core.yN_size
     col_offs0 = sorted({sg.off0 for sg in sgs})
 
+    def sample(fn, scale):
+        fn()  # warm: FFT plans, allocator, import side effects
+        ts = []
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            ts.append(time.time() - t0)
+        ts.sort()
+        return ts[0] * scale, ts[len(ts) // 2] * scale
+
     facet = make_facet(config.image_size, fcs[0], sources)
     blk = min(256, yB)
-    t0 = time.time()
-    prepare_facet_math(npk, core._Fb, yN, facet[:, :blk], fcs[0].off0, 0)
-    t_prepare = (time.time() - t0) * (yB / blk) * n_facets
+    prep_lo, prep_hi = sample(
+        lambda: prepare_facet_math(
+            npk, core._Fb, yN, facet[:, :blk], fcs[0].off0, 0
+        ),
+        (yB / blk) * n_facets,
+    )
 
     BF_F = np.zeros((yN, yB), dtype=complex)
-    t0 = time.time()
-    col = core.extract_from_facet(BF_F, col_offs0[0], 0)
-    NMBF_BF = core.prepare_facet(col, fcs[0].off1, 1)
-    t_col = (time.time() - t0) * n_facets * len(col_offs0)
+
+    def col_op():
+        col = core.extract_from_facet(BF_F, col_offs0[0], 0)
+        core.prepare_facet(col, fcs[0].off1, 1)
+
+    col_lo, col_hi = sample(col_op, n_facets * len(col_offs0))
 
     NMBF_BFs = np.zeros((n_facets, m, yN), dtype=complex)
     offs0 = [fc.off0 for fc in fcs]
     offs1 = [fc.off1 for fc in fcs]
     sg = sgs[0]
-    t0 = time.time()
-    batched.subgrid_from_columns_batch(
-        core, NMBF_BFs, offs0, offs1, sg.off0, sg.off1, sg.size,
-        (np.ones(sg.size), np.ones(sg.size)),
+    sg_lo, sg_hi = sample(
+        lambda: batched.subgrid_from_columns_batch(
+            core, NMBF_BFs, offs0, offs1, sg.off0, sg.off1, sg.size,
+            (np.ones(sg.size), np.ones(sg.size)),
+        ),
+        len(sgs),
     )
-    t_sg = (time.time() - t0) * len(sgs)
-    return t_prepare + t_col + t_sg
+    return prep_lo + col_lo + sg_lo, prep_hi + col_hi + sg_hi
 
 
 def _cover_kwargs(facet_configs, subgrid_configs):
@@ -353,11 +445,17 @@ def run_one(config_name, mode):
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
 
+    sparse_fov = None
+    if mode.endswith("-sparse"):
+        # circular-FoV sparse facet cover, composable with the streamed
+        # modes (reference scripts/demo_sparse_facet.py:34-181)
+        sparse_fov = float(os.environ.get("BENCH_SPARSE_FOV", "0.6"))
+        mode = mode[: -len("-sparse")]
     if mode not in ("batched", "roundtrip", "streamed",
                     "roundtrip-streamed", "streamed-partial"):
         raise ValueError(
             f"Unknown bench mode {mode!r} (batched|roundtrip|streamed|"
-            "roundtrip-streamed|streamed-partial)"
+            "roundtrip-streamed|streamed-partial[-sparse])"
         )
 
     def force(arr):
@@ -376,13 +474,22 @@ def run_one(config_name, mode):
         "streamed", "roundtrip-streamed", "streamed-partial"
     )
     config, fwd, facet_configs, subgrid_configs, sources = _build(
-        "planar", params, dtype, streamed=streamed_mode
+        "planar", params, dtype, streamed=streamed_mode,
+        sparse_fov=sparse_fov,
     )
     extra = {}
     finish_passes = 1
     real_facets = getattr(fwd, "_facets_real", False)
-    mode_label = mode
+    mode_label = mode if not sparse_fov else f"{mode}-sparse"
     partial_scale = None
+    if sparse_fov:
+        extra["sparse_cover"] = {
+            "fov_fraction": sparse_fov,
+            "n_facets": len(facet_configs),
+            "n_facets_dense": (
+                -(-config.image_size // config.max_facet_size)
+            ) ** 2,
+        }
 
     if mode == "streamed-partial":
         # measured PARTIAL cover: the first BENCH_PARTIAL_COLS subgrid
@@ -563,23 +670,33 @@ def run_one(config_name, mode):
                 )
             if getattr(fwd, "_facets_sparse", False):
                 # grouped sparse forward: synthesise each reference
-                # plane on device (no multi-GB re-upload). Pull each
-                # iteration's scalar before dispatching the next — the
-                # synthesised [yB, yB] planes would otherwise all go
-                # live at once (async dispatch; block_until_ready is
-                # not completion on this runtime).
+                # plane on device (no multi-GB re-upload), in ROW CHUNKS
+                # — at 64k the full [yB, yB] ref + residual transients
+                # (~6 GiB) next to the live accumulator OOM'd the
+                # verification step. Out-of-chunk pixels drop out of the
+                # scatter (mode="drop"); each chunk's scalar is pulled
+                # before the next dispatch (async dispatch would put all
+                # chunks' transients live at once).
+                yB = facets_dev.shape[1]
+                n_ch = max(1, int(yB * yB * 12 / 1.2e9))
+                while yB % n_ch:
+                    n_ch += 1
+                Cr = yB // n_ch
+                chunk_rms2 = _chunk_rms2_fn(Cr, yB)
                 rms2s = []
                 for i in range(i0, i1):
-                    ref = fwd.synth_facet_device(i)
-                    res_re = facets_dev[i - i0, :, :, 0] - ref
-                    res_im = facets_dev[i - i0, :, :, 1]
-                    rms2s.append(
-                        float(
+                    _, r, c, v = fwd._sparse_pixels(i, i + 1)
+                    total = 0.0
+                    for ci in range(n_ch):
+                        total += float(
                             np.asarray(
-                                jnp.mean(res_re * res_re + res_im * res_im)
+                                chunk_rms2(
+                                    facets_dev[i - i0], r, c, v,
+                                    jnp.int32(ci * Cr),
+                                )
                             )
                         )
-                    )
+                    rms2s.append(total / (yB * yB))
                 return jnp.asarray(rms2s)
             # re-upload per-facet references (grouped forward or
             # complex facets: no resident copy to compare against)
@@ -718,11 +835,18 @@ def run_one(config_name, mode):
             # run only 1/partial_scale of its columns
             numpy_total /= partial_scale
     elif baseline_estimated:
-        numpy_total = _numpy_baseline_from_parts(params, sources)
+        numpy_total, numpy_hi = _numpy_baseline_from_parts(params, sources)
+        scale = 1.0
+        if sparse_fov:
+            # the parts estimator times the DENSE facet cover; every
+            # cost centre scales ~linearly with facet count, so rescale
+            # to the sparse cover's
+            sc = extra["sparse_cover"]
+            scale *= sc["n_facets"] / sc["n_facets_dense"]
         if partial_scale:
             # compare like with like: the numpy estimate covers the full
             # cover, the measured run only 1/partial_scale of its columns
-            numpy_total /= partial_scale
+            scale /= partial_scale
         if mode == "roundtrip-streamed":
             # extrapolate the backward leg by the analytic FLOP ratio of
             # the two directions (their op sequences are duals with the
@@ -734,7 +858,14 @@ def run_one(config_name, mode):
 
             kw = _cover_kwargs(facet_configs, subgrid_configs)
             core = config.core
-            numpy_total *= 1.0 + _bb(core, **kw) / _fb(core, **kw)
+            scale *= 1.0 + _bb(core, **kw) / _fb(core, **kw)
+        numpy_total *= scale
+        numpy_hi *= scale
+        # vs_baseline uses the LOW end (min-of-reps): under-, never
+        # over-states the speedup; the bracket records the spread
+        extra["numpy_baseline_bracket_s"] = [
+            round(numpy_total, 2), round(numpy_hi, 2)
+        ]
     else:
         # Warm one subgrid first so the one-time facet preparation is
         # excluded from the sample, as the planar run's warmup does. Then

@@ -1263,6 +1263,395 @@ def _bwd_sampled_fold_sharded(core, mesh):
     )
 
 
+# -- FFT (spectral-embed) backward fold --------------------------------------
+#
+# The sampled fold's adjoint DFT costs 8 * R_g * yB^2 * F per column group
+# — R_g grows with the group, so fold FLOPs are ~flat per COLUMN
+# (1.7e14 at 32k, the single largest block of the backward's wall-clock,
+# measured 13.7% of peak). But the identical accumulation runs as the
+# reference-shaped adjoint chain: scatter-embed each column's rows at its
+# spectral window (`add_to_facet_math` — duplicate positions accumulate),
+# ONE matmul-FFT finish (`finish_facet_math`), add into the donated image
+# accumulator. Cost per GROUP is F * fft(yN over yB) + embeds — flat in
+# group size — so at fold groups of 3+ columns it beats the sampled fold
+# outright and keeps improving with bigger groups. Exactness: every step
+# is the linear op the `_facet_pass_bwd` path runs (tested equal to the
+# sampled fold), and fft(sum of embeds) == sum over groups by linearity.
+# The [F, yN, Cj] spectral transient is bounded by chunking the
+# pass-through output axis j (clamped starts + `keep` masking make any
+# yB exact, the `_fold_row_block` pattern).
+
+
+def _fft_fold_chunk(core, F, yB) -> int:
+    """Static j-chunk width for the FFT fold's spectral transient
+    [F, yN, Cj(,2)] — ~SWIFTLY_FFT_FOLD_CHUNK_MB (default 96) regardless
+    of config; lane-aligned like `_fold_row_block`. The matmul-FFT keeps
+    ~3 chunk-sized intermediates live, so the fold's peak transient is
+    ~3x this target — 96 MB fits the roundtrip reserve that the sampled
+    fold's 192 MB row blocks calibrated (384 MB OOM'd the 32k roundtrip
+    at col_group=3)."""
+    import os
+
+    target = float(os.environ.get("SWIFTLY_FFT_FOLD_CHUNK_MB", "96")) * 1e6
+    dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
+    per_col = max(1, F * core.yN_size * dsize)
+    C = int(target // per_col)
+    if C >= yB:
+        return yB
+    return max(1, (C // 128) * 128 or C)
+
+
+def _bwd_fft_fold_chunk_fn(core, Cj, axis_name=None):
+    """One j-chunk of the FFT fold: acc [F, yB, yB(,2)] += embed+fft+
+    finish of rows_g[:, :, :, start:start+Cj].
+
+    Dispatched once per chunk from a host loop with the accumulator
+    donated across dispatches (the sampled fold's proven pattern) — a
+    lax.scan carrying the multi-GiB accumulator through this body either
+    lost input/output aliasing (compile-time "Used 18.07G of 15.75G") or
+    hung the remote AOT compiler outright. `j0`/`start` are traced
+    device scalars so every chunk reuses ONE compiled program; the
+    clamped final chunk re-covers columns the previous chunk already
+    folded and `keep` zeroes those, making the tiling exact for any yB.
+
+    Emits the same accumulator contract as `_bwd_sampled_fold_fn` (Fb
+    weighting and spectral extraction applied; axis-0 masks left to the
+    finish), so the two folds are drop-in interchangeable per group.
+    """
+    import jax.numpy as jnp
+
+    p = core._p
+    yN = core.yN_size
+
+    def fn(acc, rows_g, col_offs0, foffs0, j0, start):
+        g = rows_g.shape[0]
+        F, yB = acc.shape[0], acc.shape[1]
+        tail = rows_g.shape[4:]
+        z = jnp.int32(0)
+        blk = jax.lax.dynamic_slice(
+            rows_g,
+            (z, z, z, start) + (z,) * len(tail),
+            (g, F, rows_g.shape[2], Cj) + tail,
+        )  # [g, F, m, Cj(,2)]
+        spec = jnp.zeros((F, yN, Cj) + tail, dtype=rows_g.dtype)
+        if axis_name is not None:
+            spec = varying(spec, axis_name)
+        # unrolled over the group's columns (g <= the feeding group cap)
+        for k in range(g):
+            spec = spec + jax.vmap(
+                lambda c, k=k: add_to_facet_math(
+                    p, yN, core.N, c, col_offs0[k], 0
+                )
+            )(blk[k])
+
+        def fin(sp, off0):
+            return finish_facet_math(p, core._Fb, yB, sp, off0, 0)
+
+        out = jax.vmap(fin)(spec, foffs0)  # [F, yB, Cj(,2)]
+        j = start + jnp.arange(Cj, dtype=jnp.int32)
+        keep = (j >= j0).astype(rows_g.dtype)
+        out = out * keep[None, None, :].reshape(
+            (1, 1, Cj) + (1,) * len(tail)
+        )
+        cur = jax.lax.dynamic_slice(
+            acc, (z, z, start) + (z,) * len(tail), (F, yB, Cj) + tail
+        )
+        return jax.lax.dynamic_update_slice(
+            acc, cur + out, (z, z, start) + (z,) * len(tail)
+        )
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_fft_fold_chunk_j(core, Cj):
+    return _jit(donate=(0,))(_bwd_fft_fold_chunk_fn(core, Cj))
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_fft_fold_chunk_sharded(core, mesh, Cj):
+    """Facet-sharded FFT fold chunk (embed + fft are facet-local; no
+    collectives — rows and acc share the facet axis)."""
+    return _shmap(
+        _bwd_fft_fold_chunk_fn(core, Cj, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(FACET_AXIS), _P(None, FACET_AXIS), _P(), _P(FACET_AXIS),
+            _P(), _P(),
+        ),
+        out_specs=_P(FACET_AXIS),
+        donate=(0,),
+    )
+
+
+# -- Cooley-Tukey sampled backward fold --------------------------------------
+#
+# The sampled fold evaluates out[f, i, j] = sum_r rows2[f, r, j] *
+# W^{-kt_r * i} (W = e^{+2pi i/yN}) as one dense [i, r] DFT per group —
+# 8 * R_g * yB^2 * F FLOPs, ~flat per COLUMN. Factoring the kernel the
+# Cooley-Tukey way over kt_r = Q*a_r + b_r and i = q*P + p (P = yN/Q):
+#
+#   W^{-kt i} = e^{-2pi i a p / P} * e^{-2pi i b p / yN} * e^{-2pi i b q / Q}
+#
+# turns the fold into three DENSE stages with no scatters or rolls:
+#   1. group rows by b-lane (a constant gather; a column's m consecutive
+#      kt values hit each b exactly ceil(m/Q) times) and contract the
+#      per-lane a-phases:        G[f,b,p,j]  (K = g*ceil(m/Q))
+#   2. elementwise twiddle e^{-2pi i b p / yN}
+#   3. one [q, b] DFT matmul:    out[f,q,p,j] -> reshape i = q*P + p
+#      (K = Q = 128, flat in group size g)
+# Stage 3 dominates at ~8 * Q * yB * yB * F FLOPs per group — R_g/Q times
+# fewer than the direct fold (3-6x at production group sizes), and the
+# MXU shapes are deep. Exactness: pure index algebra, no approximation;
+# pinned against the sampled fold by tests at every backend.
+
+
+def _ct_fold_tables(core, col_offs0):
+    """Host-side index tables for the CT fold of one column group.
+
+    Returns (Q, P, kmax, r_idx, a_vals): `r_idx[c, b, k]` is the global
+    row index (into R = g*m concatenated rows) of the k-th row of column
+    c landing in b-lane b (0 for pads), `a_vals[c, b, k]` its a-value in
+    [0, P) (or -1 for pads — the device masks those contributions).
+    Exact int64 host arithmetic (the in-trace version of this indexing is
+    what the int32-overflow class preys on).
+    """
+    import math
+
+    yN = core.yN_size
+    m = core.xM_yN_size
+    Q = math.gcd(128, yN)
+    P = yN // Q
+    kmax = -(-m // Q) if m >= Q else 1
+    g = len(col_offs0)
+    kt = sampled_row_indices(core, col_offs0).astype(np.int64)  # [g*m]
+    r_idx = np.zeros((g, Q, kmax), dtype=np.int32)
+    a_vals = np.full((g, Q, kmax), -1, dtype=np.int32)
+    fill = np.zeros((g, Q), dtype=np.int32)
+    for c in range(g):
+        for rp in range(m):
+            r = c * m + rp
+            b = int(kt[r] % Q)
+            a = int((kt[r] // Q) % P)
+            k = fill[c, b]
+            r_idx[c, b, k] = r
+            a_vals[c, b, k] = a
+            fill[c, b] += 1
+    return Q, P, kmax, r_idx, a_vals
+
+
+def _ct_fold_width(yB, all_planes_bytes) -> int:
+    """Static j-width of one CT fold launch: the largest divisor of yB
+    keeping ALL facets' concurrently-scheduled stage planes near
+    SWIFTLY_CT_FOLD_MB (default 4096 MB). The TPU AOT compiler schedules
+    every unrolled block concurrently (optimization_barrier is stripped;
+    scan carries lose aliasing), so per-launch footprint is controlled
+    by width alone."""
+    import os
+
+    target = float(os.environ.get("SWIFTLY_CT_FOLD_MB", "4096")) * 1e6
+    want = max(1, int(np.ceil(all_planes_bytes / target)))
+    for n in range(want, yB + 1):
+        if yB % n == 0:
+            return yB // n
+    return 1
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_ct_fold_fn(core, Q, P, kmax, W, axis_name=None):
+    """acc [F, yB, yB(,2)] += one j-window [j0, j0+W) of the CT-factored
+    adjoint-sampled fold of concatenated column rows [F, R, yB(,2)]
+    (same input layout and accumulator contract as
+    `_bwd_sampled_fold_fn`); the caller loops yB/W windows, donating the
+    accumulator across launches.
+
+    The program is FULLY STATIC (no lax.scan: every loop-carried
+    formulation of the multi-GiB accumulator with a non-trivial body
+    lost XLA:TPU's carry aliasing — compile-time "Used 18.07G of
+    15.75G" — or hung the remote AOT compiler), and its width W is sized
+    so that ALL facets' stage planes fit HBM even fully
+    concurrently-scheduled (the compiler strips optimization_barrier and
+    overlaps every block).
+    """
+    import jax.numpy as jnp
+
+    yN = core.yN_size
+    planar = _planar(core)
+
+    def fn(acc, rows, e0, krows, r_idx, a_vals, j0):
+        F, yB = acc.shape[0], acc.shape[1]
+        g = r_idx.shape[0]
+        fdt = acc.dtype if planar else core._Fb.real.dtype
+        Qi = -(-yB // P)
+        yB_pad = Qi * P
+
+        # e0 pre-rotation: rows2 = rows * W^{-e0_f kt_r} (the sampled
+        # fold's own formula, exact int32 via _mulmod)
+        p_cos, p_sin = _sampled_phases(
+            core, _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
+        )
+        # stage-1 a-phases: T[c, b, k, p] = exp(-2pi i a p / P), zeroed
+        # on pads (a = -1)
+        pj = jnp.arange(P, dtype=jnp.int32)
+        a_safe = jnp.maximum(a_vals, 0)
+        theta1 = (-2 * np.pi / P) * jnp.mod(
+            a_safe[..., None] * pj, P
+        ).astype(fdt)
+        mask = (a_vals >= 0).astype(fdt)[..., None]
+        T_re = jnp.cos(theta1) * mask
+        T_im = jnp.sin(theta1) * mask
+        # stage-2 twiddle W2[b, p] = exp(-2pi i b p / yN): b*p < Q*P =
+        # yN, int32-exact
+        bj = jnp.arange(Q, dtype=jnp.int32)
+        theta2 = (-2 * np.pi / yN) * (bj[:, None] * pj[None, :]).astype(fdt)
+        W2_re, W2_im = jnp.cos(theta2), jnp.sin(theta2)
+        # stage-3 DFT D[q, b] = exp(-2pi i q b / Q)
+        qj = jnp.arange(Qi, dtype=jnp.int32)
+        theta3 = (-2 * np.pi / Q) * jnp.mod(
+            qj[:, None] * bj[None, :], Q
+        ).astype(fdt)
+        D_re, D_im = jnp.cos(theta3), jnp.sin(theta3)
+        fb = core._p.extract_mid(core._Fb, yB, 0)  # [yB] real, no 1/yN
+        fbj = jnp.asarray(fb.real if not planar else fb, fdt)
+        flat_idx = r_idx.reshape(-1)  # [g*Q*kmax] constant gather
+
+        from ..ops.planar_backend import matmul_precision
+
+        prec = matmul_precision()
+
+        def ein(spec, A, B):
+            return jnp.einsum(spec, A, B, precision=prec)
+
+        def fold_one(facet_rows, ws):
+            """One facet's j-slice: gathered rows (planes or complex)
+            [g, Q, kmax, w] -> finished [w-slice of out rows]."""
+            if planar:
+                grc, gic = facet_rows
+                G_re = ein("cbkp,cbkj->bpj", T_re, grc) - ein(
+                    "cbkp,cbkj->bpj", T_im, gic
+                )
+                G_im = ein("cbkp,cbkj->bpj", T_re, gic) + ein(
+                    "cbkp,cbkj->bpj", T_im, grc
+                )
+                G2_re = (
+                    G_re * W2_re[:, :, None] - G_im * W2_im[:, :, None]
+                )
+                G2_im = (
+                    G_im * W2_re[:, :, None] + G_re * W2_im[:, :, None]
+                )
+                O_re = ein("qb,bpj->qpj", D_re, G2_re) - ein(
+                    "qb,bpj->qpj", D_im, G2_im
+                )
+                O_im = ein("qb,bpj->qpj", D_re, G2_im) + ein(
+                    "qb,bpj->qpj", D_im, G2_re
+                )
+                out = jnp.stack(
+                    [
+                        O_re.reshape(yB_pad, ws)[:yB],
+                        O_im.reshape(yB_pad, ws)[:yB],
+                    ],
+                    axis=-1,
+                )
+                return out * fbj[:, None, None]
+            (gth,) = facet_rows
+            T = (T_re + 1j * T_im).astype(core.dtype)
+            G = jnp.einsum("cbkp,cbkj->bpj", T, gth)
+            W2 = (W2_re + 1j * W2_im).astype(core.dtype)
+            G2 = G * W2[:, :, None]
+            D = (D_re + 1j * D_im).astype(core.dtype)
+            out = jnp.einsum("qb,bpj->qpj", D, G2).reshape(yB_pad, ws)[
+                :yB
+            ]
+            return out * fbj.astype(core.dtype)[:, None]
+
+        z = jnp.int32(0)
+        ztail = (z,) * (len(acc.shape) - 3)
+        for f in range(F):
+            if planar:
+                blkf = jax.lax.dynamic_slice(
+                    rows, (jnp.int32(f), z, j0, z),
+                    (1, rows.shape[1], W, 2),
+                )[0]
+                Rr, Ri = blkf[..., 0], blkf[..., 1]
+                Rr2 = Rr * p_cos[f, :, None] + Ri * p_sin[f, :, None]
+                Ri2 = Ri * p_cos[f, :, None] - Rr * p_sin[f, :, None]
+                facet_rows = (
+                    jnp.take(Rr2, flat_idx, axis=0).reshape(
+                        (g, Q, kmax, W)
+                    ),
+                    jnp.take(Ri2, flat_idx, axis=0).reshape(
+                        (g, Q, kmax, W)
+                    ),
+                )
+            else:
+                blkf = jax.lax.dynamic_slice(
+                    rows, (jnp.int32(f), z, j0), (1, rows.shape[1], W)
+                )[0]
+                phi = (p_cos[f] - 1j * p_sin[f]).astype(core.dtype)
+                facet_rows = (
+                    jnp.take(blkf * phi[:, None], flat_idx, axis=0)
+                    .reshape((g, Q, kmax, W)),
+                )
+            out = fold_one(facet_rows, W)
+            # explicit slice/update (NOT .at[...].add, whose interior
+            # slice lowers to scatter): the DUS chain is what the
+            # compiler in-places through the donated acc
+            cur = jax.lax.dynamic_slice(
+                acc, (jnp.int32(f), z, j0) + ztail,
+                (1, yB, W) + acc.shape[3:],
+            )
+            acc = jax.lax.dynamic_update_slice(
+                acc, cur + out[None], (jnp.int32(f), z, j0) + ztail
+            )
+        return acc
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_ct_fold_j(core, Q, P, kmax, W):
+    return _jit(donate=(0,))(_bwd_ct_fold_fn(core, Q, P, kmax, W))
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_ct_fold_sharded(core, mesh, Q, P, kmax, W):
+    """Facet-sharded CT fold (all stages facet-local; no collectives)."""
+    return _shmap(
+        _bwd_ct_fold_fn(core, Q, P, kmax, W, axis_name=FACET_AXIS), mesh,
+        in_specs=(
+            _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P(),
+            _P(), _P(), _P(),
+        ),
+        out_specs=_P(FACET_AXIS),
+        donate=(0,),
+    )
+
+
+def resolve_fold_mode() -> str:
+    """Backward fold body: SWIFTLY_FOLD = sampled | ct | fft | auto.
+
+    "auto" -> sampled. The alternatives cut fold FLOPs substantially
+    (ct: CT-factored, ~5x fewer at fold groups of 3; fft: spectral embed
+    + matmul-FFT, ~2x) and both are exact (tests pin all three), but on
+    the tunnel-attached v5e neither REALIZES the win: the AOT compiler
+    in-places the multi-GiB accumulator only through the sampled fold's
+    2-einsum scan body (every richer loop body lost carry aliasing —
+    compile "Used 18.07G of 15.75G" — or hung the compiler;
+    optimization_barrier is stripped, so unrolled programs schedule all
+    blocks concurrently, and width-limited launch chains pay the ~70 ms
+    per-dispatch floor x yB/W launches). Measured: sampled 0.52 s/fold
+    (g=2) vs fft 1.71 s (g=3, 22 launches) vs ct compile-OOM at every
+    one-launch shape. docs/performance.md has the full ledger.
+    """
+    import os
+
+    mode = os.environ.get("SWIFTLY_FOLD", "auto")
+    if mode not in ("ct", "fft", "sampled", "auto"):
+        raise ValueError(
+            f"SWIFTLY_FOLD must be ct|fft|sampled|auto, got {mode!r}"
+        )
+    return "sampled" if mode == "auto" else mode
+
+
 # -- device-side sparse facet synthesis -------------------------------------
 
 
@@ -2441,6 +2830,7 @@ class StreamedBackward:
         self._naf = {}  # off0 -> host/device [F, m, yB_pad(,2)] rows
         self._acc = None  # ("sampled") device [F, yB, yB(,2)] accumulator
         self._fold_group = max(1, int(fold_group))
+        self._fold_mode = resolve_fold_mode()  # sampled | ct | fft
         self._pending_rows = []  # ("sampled") [(off0, rows [F, m, yB(,2)])]
         # ("sampled") depth-2 fold-completion pipeline: dispatch is
         # asynchronous and block_until_ready is not completion on tunnel
@@ -2537,56 +2927,123 @@ class StreamedBackward:
             else:
                 self._naf[key] = np.array(rows)  # writable copy
 
+    def _ensure_acc(self):
+        import jax.numpy as jnp
+
+        base = self._base
+        if self._acc is None:
+            shape = (
+                base.stack.n_total, base.stack.size, base.stack.size
+            ) + _tail(base.core)
+            if base.mesh is not None:
+                self._acc = base._place(
+                    np.zeros(shape, dtype=_np_dtype(base.core))
+                )
+            else:
+                self._acc = jnp.zeros(shape, dtype=_np_dtype(base.core))
+
+    def _drain_folds(self, depth=1):
+        """Pull fold checksums down to `depth` in flight (genuine 8-byte
+        host round trips — see _fold_inflight comment in __init__)."""
+        while len(self._fold_inflight) > depth:
+            np.asarray(self._fold_inflight.popleft())
+
     def _fold_rows(self, offs, rows_cat):
-        """("sampled") one adjoint-sampled fold of concatenated column
-        rows [F, P*m, yB(,2)] into the image-space accumulator."""
+        """("sampled") one adjoint fold of concatenated column rows
+        [F, P*m, yB(,2)] into the image-space accumulator — the direct
+        adjoint-sampled einsum by default (measured fastest on the
+        tunnel runtime; docs/performance.md), the CT-factored body with
+        SWIFTLY_FOLD=ct."""
         import jax.numpy as jnp
 
         base = self._base
         core = base.core
         yB = base.stack.size
-        if self._acc is None:
-            shape = (base.stack.n_total, yB, yB) + _tail(core)
-            if base.mesh is not None:
-                self._acc = base._place(
-                    np.zeros(shape, dtype=_np_dtype(core))
-                )
-            else:
-                self._acc = jnp.zeros(shape, dtype=_np_dtype(core))
+        self._ensure_acc()
         e0 = getattr(self, "_e0_dev", None)
         if e0 is None:
             e0 = self._e0_dev = base._place(
                 (np.asarray(base.stack.offs0) - yB // 2).astype(np.int32)
             )
         krows = jnp.asarray(sampled_row_indices(core, offs))
-        if base.mesh is not None:
-            foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
+        self._drain_folds()
+        if self._fold_mode == "ct":
+            Q, P, kmax, r_idx, a_vals = _ct_fold_tables(core, offs)
+            F = base.stack.n_total // _mesh_size(base.mesh)
+            itemsize = np.dtype(_np_dtype(core)).itemsize
+            planes = 2 * F * core.yN_size * yB * (
+                itemsize if _planar(core) else itemsize // 2
+            )
+            W = _ct_fold_width(yB, planes)
+            if base.mesh is not None:
+                foldfn = _bwd_ct_fold_sharded(
+                    core, base.mesh, Q, P, kmax, W
+                )
+            else:
+                foldfn = _bwd_ct_fold_j(core, Q, P, kmax, W)
+            ri, av = jnp.asarray(r_idx), jnp.asarray(a_vals)
+            for j0 in range(0, yB, W):
+                self._acc = foldfn(
+                    self._acc, rows_cat, e0, krows, ri, av,
+                    jnp.int32(j0),
+                )
         else:
-            foldfn = _bwd_sampled_fold_j(core)
-        # backpressure: drain to depth 1 before dispatching (genuine
-        # 8-byte host pulls — see _fold_inflight comment in __init__)
-        while len(self._fold_inflight) >= 2:
-            np.asarray(self._fold_inflight.popleft())
-        self._acc = foldfn(self._acc, rows_cat, e0, krows)
+            if base.mesh is not None:
+                foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
+            else:
+                foldfn = _bwd_sampled_fold_j(core)
+            self._acc = foldfn(self._acc, rows_cat, e0, krows)
         # the checksum slice depends on the whole fold having executed
+        self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
+
+    def _fold_rows_fft(self, offs, rows_g):
+        """("sampled", fft fold) one FFT-based adjoint fold of a column
+        group's rows [g, F, m, yB(,2)] into the image accumulator —
+        dispatched as one donation-chained program per j-chunk."""
+        import jax.numpy as jnp
+
+        base = self._base
+        core = base.core
+        yB = base.stack.size
+        self._ensure_acc()
+        offs_dev = jnp.asarray(np.asarray(offs, dtype=np.int32))
+        F = base.stack.n_total // _mesh_size(base.mesh)
+        Cj = min(_fft_fold_chunk(core, F, yB), yB)
+        if base.mesh is not None:
+            foldfn = _bwd_fft_fold_chunk_sharded(core, base.mesh, Cj)
+        else:
+            foldfn = _bwd_fft_fold_chunk_j(core, Cj)
+        self._drain_folds()
+        for ci in range(-(-yB // Cj)):
+            j0 = ci * Cj
+            start = min(j0, yB - Cj)
+            self._acc = foldfn(
+                self._acc, rows_g, offs_dev, base._foffs0,
+                jnp.int32(j0), jnp.int32(start),
+            )
         self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
 
     def _flush_folds(self):
         """("sampled") fold the pending columns' rows into the image-space
-        accumulator: one adjoint-sampled einsum over fold_group*m rows."""
+        accumulator: one fold over the pending group, via the body
+        `resolve_fold_mode` selected (sampled einsum by default)."""
         import jax.numpy as jnp
 
         if not self._pending_rows:
             return
         offs = [o for o, _ in self._pending_rows]
-        rows_cat = (
-            self._pending_rows[0][1]
-            if len(self._pending_rows) == 1
-            else jnp.concatenate(
-                [r for _, r in self._pending_rows], axis=1
-            )
-        )  # [F, P*m, yB(,2)]
-        self._fold_rows(offs, rows_cat)
+        if self._fold_mode == "fft":
+            rows_g = jnp.stack([r for _, r in self._pending_rows])
+            self._fold_rows_fft(offs, rows_g)
+        else:
+            rows_cat = (
+                self._pending_rows[0][1]
+                if len(self._pending_rows) == 1
+                else jnp.concatenate(
+                    [r for _, r in self._pending_rows], axis=1
+                )
+            )  # [F, P*m, yB(,2)]
+            self._fold_rows(offs, rows_cat)
         self._pending_rows = []
 
     def add_subgrid_group(self, col_sg_lists, subgrids_group):
@@ -2661,6 +3118,11 @@ class StreamedBackward:
                 base._foffs1,
                 base._masks1_dev,
             )  # [g, F, m, yB(,2)]
+            if self._fold_mode == "fft":
+                # the FFT fold takes per-column rows directly; its cost
+                # is flat in g, so the whole chunk folds in one dispatch
+                self._fold_rows_fft(offs[j : j + cap], rows)
+                continue
             rows_cat = jnp.moveaxis(rows, 0, 1).reshape(
                 (rows.shape[1], rows.shape[0] * rows.shape[2])
                 + rows.shape[3:]

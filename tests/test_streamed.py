@@ -344,8 +344,13 @@ def test_forward_rejects_sampled_residency():
 
 @pytest.mark.parametrize("backend", ["jax", "planar"])
 @pytest.mark.parametrize("fold_group", [1, 3])
-def test_sampled_backward_matches_fft_backward(backend, fold_group):
-    """The adjoint-sampled einsum fold == the FFT-based facet pass."""
+@pytest.mark.parametrize("fold_mode", ["sampled", "fft", "ct"])
+def test_sampled_backward_matches_fft_backward(
+    backend, fold_group, fold_mode, monkeypatch
+):
+    """All three sampled-residency fold bodies (adjoint-sampled einsum,
+    FFT spectral embed, CT-factored) == the FFT-based facet pass."""
+    monkeypatch.setenv("SWIFTLY_FOLD", fold_mode)
     config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
     fwd = StreamedForward(config, facet_tasks, col_block=416)
     subgrids = fwd.all_subgrids(subgrid_configs)
@@ -356,6 +361,7 @@ def test_sampled_backward_matches_fft_backward(backend, fold_group):
     out_b = StreamedBackward(
         config, facet_configs, residency="sampled", fold_group=fold_group
     )
+    assert out_b._fold_mode == fold_mode
     out_b.add_subgrids(tasks)
     out = out_b.finish()
     np.testing.assert_allclose(out, ref, atol=1e-10)
