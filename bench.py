@@ -635,8 +635,15 @@ def run_one(config_name, mode):
             n_parts = 1
         else:
             usable = budget - fwd_min - reserve
-            F_sub = max(1, int(usable // _per_facet_resident()))
-            n_parts = -(-F_total // F_sub)
+            if F_total * _per_facet_resident() <= usable:
+                n_parts = 1
+            else:
+                # once partitioning is forced, single-facet passes win:
+                # the forward replay dominates each pass and its column
+                # group scales with the headroom the accumulator leaves
+                # (measured at 64k: 9 passes at G=4 take 655 s; 5
+                # two-facet passes at G=2 extrapolate to ~3000 s)
+                n_parts = F_total
         # equal-size parts minimise distinct jit shapes (one extra
         # compile per distinct per-pass facet count)
         F_sub = -(-F_total // n_parts)
@@ -969,7 +976,9 @@ def main():
             "4k[1]-n2k-512:batched,4k[1]-n2k-512:roundtrip,"
             "32k[1]-n16k-512:streamed,"
             "32k[1]-n16k-512:roundtrip-streamed,"
+            "32k[1]-n16k-512:streamed-sparse,"
             "128k[1]-n32k-512:streamed-partial,"
+            "64k[1]-n32k-512:roundtrip-streamed,"
             "64k[1]-n32k-512:streamed",
         )
         entries = []

@@ -2708,11 +2708,13 @@ def grouped_col_group_for_budget(
     # 4x the group buffer: the sampled pass materialises out_re/out_im
     # and their stacked pair next to the [Fg, G*m, yB] buffer and its
     # in-step transpose. The accumulator is pre-finish [S, xM, xM];
-    # the finished group array plus the yielded per-column slices a
-    # consumer holds while the next group dispatches add 3x [S, xA, xA]
-    # (unmodelled transients behind BENCH_r04 32k OOMs).
+    # the finished group array plus the depth-2 pipeline's in-flight
+    # copy add 2x [S, xA, xA]. (Was 3x after the BENCH_r04 32k OOMs;
+    # recalibrated against measured 128k runs — G=4 streams green where
+    # the 3x model allowed only G=2, and the OOM boundary sits at G=6
+    # with two groups in flight.)
     per_G = (
-        4 * facet_group * m * yB + S * xM * xM + 3 * S * xA * xA
+        4 * facet_group * m * yB + S * xM * xM + 2 * S * xA * xA
     ) * dsize
     reserve = 0.6e9
     headroom = budget - slab_b - chunk_b - reserve
