@@ -83,10 +83,12 @@ def resolve_colpass(core, n_facets_in_program: int) -> str:
 
 def resolve_colpass_bwd(core, n_facets_in_program: int) -> str:
     """Backward column-pass body: SWIFTLY_COLPASS_BWD if set (einsum|
-    fft), else fft — measured on v5e (32k roundtrip, G=3): the adjoint
-    einsum body's K=xM contractions cost ~2x the chain's FLOPs without
-    a facet-reduction payoff (the output stays per-facet), 66.3 s with
-    fft backward vs 80.4 s with einsum backward."""
+    fft), else einsum — re-measured on v5e r5 (32k round trip, fg=2):
+    41.8 s einsum vs 48.3 s fft chain. The r4 measurement had einsum
+    LOSING (80.4 vs 66.3 s), but that predated the one-shot
+    `_bwd_scatter_rows` accumulator and the rebalanced Sb blocks; with
+    those, the adjoint einsums' K=xM MXU contractions beat the
+    per-(subgrid, facet) fft chains despite ~2x the FLOPs."""
     mode = os.environ.get("SWIFTLY_COLPASS_BWD", "")
     if mode:
         if mode not in ("einsum", "fft"):
@@ -94,7 +96,7 @@ def resolve_colpass_bwd(core, n_facets_in_program: int) -> str:
                 f"SWIFTLY_COLPASS_BWD must be einsum|fft, got {mode!r}"
             )
         return mode
-    return "fft"
+    return "einsum"
 
 
 def _per_subgrid_flops(

@@ -291,7 +291,8 @@ def test_memory_sampler_html_single_sample_and_escaping(tmp_path):
 
 def test_colpass_resolution(monkeypatch):
     """SWIFTLY_COLPASS / SWIFTLY_COLPASS_BWD resolution: auto picks
-    einsum for the forward, fft for the backward; explicit values win;
+    einsum for BOTH directions (backward flipped in r5 after the
+    scatter-add + Sb-rebalance re-measurement); explicit values win;
     invalid values raise (never silently fall back)."""
     from swiftly_tpu.ops.core import SwiftlyCore
     from swiftly_tpu.utils.flops import (
@@ -305,10 +306,14 @@ def test_colpass_resolution(monkeypatch):
     monkeypatch.delenv("SWIFTLY_COLPASS_BWD", raising=False)
     assert colpass_mode() == "auto"
     assert resolve_colpass(core, 1) == "einsum"
+    assert resolve_colpass_bwd(core, 9) == "einsum"
+    monkeypatch.setenv("SWIFTLY_COLPASS_BWD", "fft")
     assert resolve_colpass_bwd(core, 9) == "fft"
+    monkeypatch.delenv("SWIFTLY_COLPASS_BWD")
     monkeypatch.setenv("SWIFTLY_COLPASS", "fft")
     assert resolve_colpass(core, 9) == "fft"
-    assert resolve_colpass_bwd(core, 9) == "fft"
+    # the forward knob does not leak into the backward resolution
+    assert resolve_colpass_bwd(core, 9) == "einsum"
     monkeypatch.setenv("SWIFTLY_COLPASS_BWD", "einsum")
     assert resolve_colpass_bwd(core, 9) == "einsum"
     monkeypatch.setenv("SWIFTLY_COLPASS", "einsumm")
