@@ -402,6 +402,31 @@ def test_sampled_fold_row_blocking(monkeypatch):
     np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
+def test_facet_partitioned_sampled_backward_matches_full():
+    """The 64k-scale mechanism at test size: running the sampled
+    backward as per-facet-subset passes (each seeing ALL subgrids)
+    and concatenating equals the single full-facet-set backward —
+    the accumulator partitioning the bench uses when the whole
+    image-space accumulator exceeds HBM."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+
+    full_b = StreamedBackward(config, facet_configs, residency="sampled")
+    full_b.add_subgrids(tasks)
+    full = full_b.finish()
+
+    parts = []
+    for i0 in range(0, len(facet_configs), 2):
+        part_b = StreamedBackward(
+            config, facet_configs[i0 : i0 + 2], residency="sampled"
+        )
+        part_b.add_subgrids(tasks)
+        parts.append(part_b.finish())
+    np.testing.assert_allclose(np.concatenate(parts), full, atol=1e-12)
+
+
 def test_streamed_rejects_empty_facets():
     config = SwiftlyConfig(backend="planar", **TEST_PARAMS)
     with pytest.raises(ValueError, match="non-empty"):
