@@ -489,10 +489,16 @@ def test_sampled_backward_checkpoint(tmp_path):
         restore_streamed_backward_state(path, b3)
 
 
-def test_sampled_backward_mesh_matches_single_device():
-    """The sampled backward on a facet-sharded mesh == single device."""
+@pytest.mark.parametrize("fold_mode", ["sampled", "ct", "fft"])
+def test_sampled_backward_mesh_matches_single_device(
+    fold_mode, monkeypatch
+):
+    """The sampled backward on a facet-sharded mesh == single device,
+    for every fold body (the ct/fft shard_map variants are facet-local
+    with no collectives and must match exactly)."""
     from swiftly_tpu.parallel.mesh import make_facet_mesh
 
+    monkeypatch.setenv("SWIFTLY_FOLD", fold_mode)
     mesh = make_facet_mesh()
 
     def run(config):
