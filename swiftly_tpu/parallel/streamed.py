@@ -331,6 +331,7 @@ def _colpass_einsum_body(
     S = sg_offs.shape[0]
     Sb = min(_colpass_sblock(), S)
     nb = -(-S // Sb)
+    Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
     if nb == 1:
         P = block(sg_offs)
     else:
@@ -616,6 +617,7 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
         S = sg_offs.shape[0]
         Sb = min(_colpass_sblock(), S)
         nb = -(-S // Sb)
+        Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
         pad = nb * Sb - S
         sg_p, so_p = subgrids, sg_offs
         if pad:
@@ -2693,6 +2695,7 @@ def grouped_col_group_for_budget(
         # per column in the chunk vmap: prep1 rows, the H buffer plus its
         # wrap-extended gather copy, and one [Sb, Fg, xM, m] gather block
         Sb = min(_colpass_sblock(), S)
+        Sb = -(-S // -(-S // Sb))  # executed blocks are rebalanced
         chunk_b = (
             chunk * S * xM * xM
             + chunk * facet_group * (
@@ -2772,6 +2775,7 @@ def col_group_for_budget(base, budget, n_cols, real=False):
         # in-program transpose) and the in-flight output stacks scale
         # with G
         Sb = min(_colpass_sblock(), S)
+        Sb = -(-S // -(-S // Sb))  # executed blocks are rebalanced
         flat_col = (
             F * m * core.yN_size
             + F * xM * (2 * core.yN_size + m)
