@@ -532,9 +532,12 @@ def run_one(config_name, mode):
             depends on EVERY column's output, then one 8-byte pull —
             blocking on the last output alone under-reports on runtimes
             whose block_until_ready does not imply whole-queue completion
-            (the tunnel-attached TPU here)."""
+            (the tunnel-attached TPU here). Records the dispatch-loop
+            vs final-drain split (`stream_s` / `drain_s`) so artifacts
+            separate streaming from the completion tail."""
             acc = None
             max_rms2 = jnp.zeros((), dtype=jnp.float32)
+            t0 = time.time()
             for items, out in fwd.stream_columns(
                 subgrid_configs, device_arrays=True
             ):
@@ -549,7 +552,10 @@ def run_one(config_name, mode):
                                 config.core, out[srow], oracle_dev[k]
                             ),
                         )
+            t1 = time.time()
             float(np.asarray(acc))
+            extra["stream_s"] = round(t1 - t0, 2)
+            extra["drain_s"] = round(time.time() - t1, 2)
             return float(np.asarray(max_rms2)) ** 0.5
 
         log.info("streamed: warmup pass (compile + facet upload)")
@@ -734,7 +740,9 @@ def run_one(config_name, mode):
             replays per pass)."""
             _set_headroom()
             max_rms2 = 0.0
+            extra["pass_s"] = []
             for kpart, (i0, i1) in enumerate(parts):
+                t_pass = time.time()
                 bwd = StreamedBackward(
                     config, list(facet_configs[i0:i1]),
                     residency="sampled", fold_group=fold_group[0],
@@ -752,6 +760,7 @@ def run_one(config_name, mode):
                 rms2 = _verify_part(facets_dev, i0, i1)
                 max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
                 del facets_dev, bwd
+                extra["pass_s"].append(round(time.time() - t_pass, 1))
                 if len(parts) > 1:
                     log.info(
                         "roundtrip pass %d/%d (facets %d:%d) done",
