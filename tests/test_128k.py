@@ -191,3 +191,31 @@ def test_128k_proxy_streamed_forward_vs_oracle():
             config.image_size, sg, config.core.as_complex(out[i]), sources
         )
         assert err < 1e-8
+
+
+def test_scaled_offset_guard_rejects_unsafe_sizes():
+    """The staged-limb helper must refuse (N, num) pairs whose partial
+    products could wrap int32, rather than silently degrade."""
+    with pytest.raises(AssertionError):
+        scaled_offset(1, 1 << 23, 1 << 23)
+
+
+def test_bench_sparse_sources_inside_fov_cover():
+    """Every spread bench source, rescaled for the sparse-FoV mode, must
+    lie inside the circle of covered facet CENTRES for the catalogue's
+    worst facet/image ratio (the code-review failure case: per-coordinate
+    bounding let corner sources escape the cover)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from bench import _bench_sources
+
+    for N, facet in [(131072, 13312), (32768, 11264), (131072, 45056)]:
+        for fov in (0.6, 0.9):
+            lim = max(fov / 2 - facet / (2 * N), 4 / N)
+            for (_, r, c) in (
+                (w, int(r * lim / 0.56), int(c * lim / 0.56))
+                for (w, r, c) in _bench_sources(N)
+            ):
+                assert (r * r + c * c) ** 0.5 <= lim * N + 1, (N, facet, fov, r, c)
