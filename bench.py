@@ -50,6 +50,23 @@ import numpy as np
 log = logging.getLogger("bench")
 
 
+# Centre-relative source positions (fractions of N) for _bench_sources —
+# module-level so the sparse-FoV rescale divisor derives from the SAME
+# table (no hand-kept constant to go stale when the spread set changes).
+_BENCH_SOURCE_FRACTIONS = [
+    (-0.41, -0.37), (-0.23, 0.11), (-0.05, 0.43), (0.02, -0.19),
+    (0.17, 0.31), (0.29, -0.45), (0.36, 0.07), (0.44, -0.02),
+]
+
+
+def _bench_source_radius():
+    """Max centre-relative RADIUS of the spread source table — the
+    sparse-FoV rescale divisor. Derived from the table itself so an
+    edit to the fractions can never silently leave a stale divisor that
+    lets corner sources escape the covered circle."""
+    return max((a * a + b * b) ** 0.5 for a, b in _BENCH_SOURCE_FRACTIONS)
+
+
 def _bench_sources(N):
     """Point sources SPREAD across the whole image (centre-relative,
     fractions of N), so every subgrid column band carries nontrivial
@@ -60,13 +77,9 @@ def _bench_sources(N):
     int32 offset-scaling overflow that extracted half the cover's columns
     from the wrong window (see ops.core.scaled_offset).
     """
-    fr = [
-        (-0.41, -0.37), (-0.23, 0.11), (-0.05, 0.43), (0.02, -0.19),
-        (0.17, 0.31), (0.29, -0.45), (0.36, 0.07), (0.44, -0.02),
-    ]
     return [
         (1.0 + 0.25 * k, int(a * N), int(b * N))
-        for k, (a, b) in enumerate(fr)
+        for k, (a, b) in enumerate(_BENCH_SOURCE_FRACTIONS)
     ]
 
 
@@ -97,12 +110,13 @@ def _build(backend, params, dtype=None, streamed=False, sparse_fov=None):
             - config.max_facet_size / (2 * config.image_size),
             4 / config.image_size,
         )
-        # rescale by the spread set's max RADIUS (sqrt(.41^2+.37^2) =
-        # 0.553) so every source lands inside the circle of covered
-        # facet CENTRES — bounding per-coordinate instead lets corner
-        # sources escape the cover (reported as oracle RMS failures)
+        # rescale by the spread set's max RADIUS so every source lands
+        # inside the circle of covered facet CENTRES — bounding
+        # per-coordinate instead lets corner sources escape the cover
+        # (reported as oracle RMS failures)
+        rad = _bench_source_radius()
         sources = [
-            (w, int(r * lim_frac / 0.56), int(c * lim_frac / 0.56))
+            (w, int(r * lim_frac / rad), int(c * lim_frac / rad))
             for (w, r, c) in _bench_sources(config.image_size)
         ]
     else:
@@ -444,7 +458,10 @@ def run_one(config_name, mode):
     import jax.numpy as jnp
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
+    from swiftly_tpu.obs import Heartbeat, metrics
 
+    if metrics.enabled():
+        metrics.reset()  # one telemetry export per configuration record
     sparse_fov = None
     if mode.endswith("-sparse"):
         # circular-FoV sparse facet cover, composable with the streamed
@@ -538,6 +555,11 @@ def run_one(config_name, mode):
             acc = None
             max_rms2 = jnp.zeros((), dtype=jnp.float32)
             t0 = time.time()
+            hb = Heartbeat(
+                len(subgrid_configs), label=f"{config_name} subgrids",
+                interval_s=float(os.environ.get("BENCH_HEARTBEAT_S", "30")),
+                log=log,
+            )
             for items, out in fwd.stream_columns(
                 subgrid_configs, device_arrays=True
             ):
@@ -552,6 +574,8 @@ def run_one(config_name, mode):
                                 config.core, out[srow], oracle_dev[k]
                             ),
                         )
+                hb.update(len(items))
+            hb.finish()
             t1 = time.time()
             float(np.asarray(acc))
             extra["stream_s"] = round(t1 - t0, 2)
@@ -741,6 +765,12 @@ def run_one(config_name, mode):
             _set_headroom()
             max_rms2 = 0.0
             extra["pass_s"] = []
+            hb = Heartbeat(
+                len(subgrid_configs) * len(parts),
+                label=f"{config_name} roundtrip subgrids",
+                interval_s=float(os.environ.get("BENCH_HEARTBEAT_S", "30")),
+                log=log,
+            )
             for kpart, (i0, i1) in enumerate(parts):
                 t_pass = time.time()
                 bwd = StreamedBackward(
@@ -756,6 +786,7 @@ def run_one(config_name, mode):
                     bwd.add_subgrid_group(
                         [[sg for _, sg in col] for col in per_col], group
                     )
+                    hb.update(sum(len(col) for col in per_col))
                 facets_dev = bwd.finish_device()
                 rms2 = _verify_part(facets_dev, i0, i1)
                 max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
@@ -842,6 +873,12 @@ def run_one(config_name, mode):
     log.info("numpy baseline measurement")
     baseline_estimated = streamed_mode
     env_baseline = os.environ.get("BENCH_NUMPY_BASELINE_S")
+    if baseline_estimated and env_baseline:
+        baseline_source = "operator"
+    elif baseline_estimated:
+        baseline_source = "estimated"
+    else:
+        baseline_source = "measured"
     if baseline_estimated and env_baseline:
         # operator-supplied (e.g. from a prior run of the same config):
         # the 64k-scale sampled sub-ops alone take minutes of host time
@@ -939,6 +976,8 @@ def run_one(config_name, mode):
         probed = probe_hbm_bytes()
         if probed:
             extra["hbm_probe_gib"] = round(probed / 2**30, 2)
+    from swiftly_tpu.obs import run_manifest
+
     result = {
         "metric": f"{config_name} {direction} wall-clock "
                   f"({len(subgrid_configs)} subgrids, planar f32, "
@@ -949,6 +988,7 @@ def run_one(config_name, mode):
         "rms_vs_dft_oracle": float(f"{rms:.3e}"),
         "numpy_baseline_s": round(numpy_total, 2),
         "baseline_estimated": baseline_estimated,
+        "baseline_source": baseline_source,
         "n_subgrids": len(subgrid_configs),
     }
     result.update(extra)
@@ -959,13 +999,102 @@ def run_one(config_name, mode):
             colpass=(extra.get("plan") or {}).get("colpass"),
         )
     )
+    # provenance: every record is self-describing (device, git SHA, env
+    # knobs, config hash, baseline pedigree) — VERDICT r5's unauditable-
+    # artifact findings are structurally impossible with the stamp
+    result["manifest"] = run_manifest(
+        baseline_source=baseline_source,
+        params={"config": config_name, "mode": mode_label, **params},
+    )
+    if metrics.enabled():
+        result["telemetry"] = metrics.export()
     return result
+
+
+def smoke():
+    """Fast schema-validation leg (`bench.py --smoke`, wired into the
+    tier-1 tests): run the 1k round trip with telemetry ON, write the
+    BENCH-style artifact plus the JSONL event log, and validate what was
+    emitted — full run manifest present, `baseline_source` set, >= 6
+    distinct engine stage names, per-stage wall/MFU summary. Schema
+    drift fails HERE, in seconds on CPU, not months later in an
+    unauditable artifact."""
+    from swiftly_tpu.obs import metrics, validate_artifact
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    out_path = os.environ.get("BENCH_SMOKE_OUT", "BENCH_smoke.json")
+    jsonl_path = os.environ.get(
+        "SWIFTLY_METRICS_JSONL", out_path + "l"
+    )
+    # placeholder roofline so the MFU arithmetic is exercised on CPU
+    # (recorded in the manifest's env capture; a real run sets a
+    # measured value or runs on a device with a published peak)
+    os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+    metrics.enable(jsonl_path)
+    name = os.environ.get("BENCH_SMOKE_CONFIG", "1k[1]-n512-256")
+    record = run_one(name, "roundtrip-streamed")
+    problems = validate_artifact(record)
+    telemetry = record.get("telemetry") or {}
+    stages = telemetry.get("stages") or {}
+    engine_stages = {
+        s for s in stages if s.startswith(("fwd.", "bwd."))
+    }
+    if len(engine_stages) < 6:
+        problems.append(
+            f"expected >= 6 engine stage names, got {sorted(engine_stages)}"
+        )
+    for s, entry in stages.items():
+        for field in ("count", "total_s", "mean_s", "p99_s"):
+            if field not in entry:
+                problems.append(f"stage {s} missing {field}")
+    if not (telemetry.get("total") or {}).get("mfu_pct"):
+        problems.append("telemetry total missing mfu_pct")
+    import json as _json
+
+    with open(jsonl_path) as fh:
+        jsonl_stages = {
+            r["name"]
+            for r in map(_json.loads, fh)
+            if r.get("kind") == "stage"
+        }
+    if len({s for s in jsonl_stages if s.startswith(("fwd.", "bwd."))}) < 6:
+        problems.append(
+            f"JSONL event log has stage names {sorted(jsonl_stages)}, "
+            "expected >= 6 engine stages"
+        )
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    metrics.disable()
+    print(
+        json.dumps(
+            {
+                "smoke": "ok" if not problems else "failed",
+                "config": name,
+                "artifact": out_path,
+                "jsonl": jsonl_path,
+                "n_engine_stages": len(engine_stages),
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
 
 
 def main():
     import signal
 
+    from swiftly_tpu.obs import PartialArtifactWriter
     from swiftly_tpu.utils import enable_compilation_cache
+
+    if "--smoke" in sys.argv:
+        sys.exit(smoke())
 
     # progress visibility for the hour-scale configs: BENCH_LOGLEVEL=INFO
     # streams per-phase and per-sweep lines to stderr
@@ -975,6 +1104,13 @@ def main():
         stream=sys.stderr,
     )
     enable_compilation_cache()
+    # incremental per-leg flush: a killed run (BENCH_r05 died at rc=124)
+    # still leaves every FINISHED leg's full record on disk, plus a
+    # "started" marker naming the leg it died in. BENCH_PARTIAL_PATH=""
+    # disables.
+    partial = PartialArtifactWriter(
+        os.environ.get("BENCH_PARTIAL_PATH", "BENCH_partial.jsonl")
+    )
 
     legacy = os.environ.get("BENCH_CONFIG")
     if legacy:
@@ -1019,30 +1155,32 @@ def main():
         is_headline = pos == len(entries) - 1
         elapsed = time.time() - t_start
         if budget_s and not is_headline and elapsed > 0.75 * budget_s:
-            print(
-                json.dumps(
-                    {
-                        "metric": f"{name} ({mode})",
-                        "skipped": "time budget",
-                        "elapsed_s": round(elapsed, 1),
-                    }
-                ),
-                flush=True,
-            )
+            skip_record = {
+                "metric": f"{name} ({mode})",
+                "skipped": "time budget",
+                "elapsed_s": round(elapsed, 1),
+            }
+            print(json.dumps(skip_record), flush=True)
+            partial.append(skip_record)
             continue
+        partial.append(
+            {"leg": name, "mode": mode, "status": "started",
+             "elapsed_s": round(elapsed, 1)}
+        )
         try:
-            line = json.dumps(run_one(name, mode))
+            record = run_one(name, mode)
+            line = json.dumps(record)
             print(line, flush=True)
+            partial.append(record)
             if is_headline:
                 state["headline_line"] = line
             ok[pos] = True
         except Exception:  # pragma: no cover - report and move on
             ok[pos] = False
             traceback.print_exc(file=sys.stderr)
-            print(
-                json.dumps({"metric": f"{name} ({mode})", "error": "failed"}),
-                flush=True,
-            )
+            fail_record = {"metric": f"{name} ({mode})", "error": "failed"}
+            print(json.dumps(fail_record), flush=True)
+            partial.append(fail_record)
     if state["headline_line"]:
         print(state["headline_line"], flush=True)
     sys.exit(0 if ok.get(len(entries) - 1) else 1)

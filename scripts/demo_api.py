@@ -84,6 +84,7 @@ def run_streamed_with_checkpoint(
 
 def demo_api(args, params, config_name=""):
     """Run one config end-to-end; returns max facet RMS error."""
+    from swiftly_tpu.obs import Heartbeat
     from swiftly_tpu import (
         SWIFT_CONFIGS,
         SwiftlyBackward,
@@ -157,12 +158,13 @@ def demo_api(args, params, config_name=""):
                 [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)],
             )
         elif streamed:
-            progress = {"done": 0}
+            hb = Heartbeat(
+                len(subgrid_configs), label="subgrids",
+                interval_s=getattr(args, "heartbeat_s", 30.0), log=log,
+            )
 
             def on_column(items):
-                progress["done"] += len(items)
-                log.info("column done: %d/%d subgrids", progress["done"],
-                         len(subgrid_configs))
+                hb.update(len(items))
 
             ck_path = None
             if args.checkpoint:
@@ -252,8 +254,13 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
         transfer["backward_bytes_per_subgrid"] * n_subgrids
     )
 
+    from swiftly_tpu.obs import metrics, run_manifest
+
     summary = {
         "config": config_name,
+        "manifest": run_manifest(
+            params={"config": config_name, "execution": args.execution}
+        ),
         "backend": args.backend,
         "precision": args.precision,
         "execution": args.execution,
@@ -274,6 +281,8 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
         "memory_csv": str(mem_csv),
         "report_html": str(report_html),
     }
+    if metrics.enabled():
+        summary["telemetry"] = metrics.export()
     summary_path = out / f"summary_{tag}.json"
     summary_path.write_text(json.dumps(summary, indent=2))
     log.info("artifacts written: %s, %s", mem_csv, summary_path)
@@ -281,8 +290,18 @@ def _write_artifacts(args, config, config_name, mesh, n_subgrids, elapsed,
 
 def main():
     logging.basicConfig(level=logging.INFO, format="%(message)s")
-    args = cli_parser(__doc__).parse_args()
+    parser = cli_parser(__doc__)
+    parser.add_argument(
+        "--heartbeat_s", type=float, default=30.0,
+        help="streamed executions: seconds between progress/ETA lines",
+    )
+    args = parser.parse_args()
     setup_jax(args)
+
+    if args.metrics or args.metrics_jsonl:
+        from swiftly_tpu.obs import metrics
+
+        metrics.enable(args.metrics_jsonl)
 
     from swiftly_tpu import SWIFT_CONFIGS
 

@@ -139,6 +139,22 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
              "(parity with the reference demo's performance report / "
              "memory CSV / transfer txt)",
     )
+    parser.add_argument(
+        "--metrics",
+        action="store_true",
+        help="enable the per-stage metrics registry (swiftly_tpu.obs): "
+             "host stage timers paired with jax.profiler "
+             "TraceAnnotations, per-stage FLOPs/MFU, and a telemetry "
+             "block in the summary artifact (equivalent to "
+             "SWIFTLY_METRICS=1)",
+    )
+    parser.add_argument(
+        "--metrics_jsonl",
+        type=str,
+        default=None,
+        help="also append per-stage telemetry events to this JSONL file "
+             "(implies --metrics; equivalent to SWIFTLY_METRICS_JSONL)",
+    )
     return parser
 
 

@@ -27,6 +27,7 @@ import logging
 import numpy as np
 
 from .models.config import FacetConfig, SubgridConfig, SwiftlyConfig
+from .obs import metrics as _metrics
 from .models.covers import (
     make_full_facet_cover,
     make_full_subgrid_cover,
@@ -425,15 +426,20 @@ class SwiftlyForward:
 
     def _get_BF_Fs(self):
         if self._BF_Fs is None:
-            facets = self.stack.pad_data(
-                np.stack(
-                    [np.asarray(d, dtype=complex) for d in self._facet_data]
+            with _metrics.stage("fwd.prepare_facets") as st:
+                facets = self.stack.pad_data(
+                    np.stack(
+                        [
+                            np.asarray(d, dtype=complex)
+                            for d in self._facet_data
+                        ]
+                    )
                 )
-            )
-            facets = _place(self.core, self.mesh, facets, True)
-            self._BF_Fs = batched.prepare_facets_batch(
-                self.core, facets, self._offs0
-            )
+                st.bytes_moved = int(facets.nbytes)  # h2d upload volume
+                facets = _place(self.core, self.mesh, facets, True)
+                self._BF_Fs = batched.prepare_facets_batch(
+                    self.core, facets, self._offs0
+                )
         return self._BF_Fs
 
     def _get_columns(self, off0):
@@ -558,16 +564,30 @@ class SwiftlyForward:
             ms = [_subgrid_masks(sg) for _, sg in col]
             masks0.append([m[0] for m in ms])
             masks1.append([m[1] for m in ms])
-        if self.mesh is not None and _use_shard_map(self.config):
-            stacked = sharded.forward_all_sharded(
-                self.core, self.mesh, self._get_BF_Fs(), self._offs0,
-                self._offs1, col_offs0, sg_offs1, size, masks0, masks1,
+        fused_flops = 0
+        if _metrics.enabled():
+            from .utils.flops import forward_batched_flops
+
+            fused_flops = forward_batched_flops(
+                self.core,
+                n_facets=self.stack.n_real,
+                facet_size=self.stack.size,
+                n_columns=len(col_offs0),
+                subgrids_per_column=max_S,
+                subgrid_size=size,
             )
-        else:
-            stacked = batched.forward_all_batch(
-                self.core, self._get_BF_Fs(), self._offs0, self._offs1,
-                col_offs0, sg_offs1, size, masks0, masks1,
-            )
+            _metrics.count("fwd.subgrids", len(subgrid_configs))
+        with _metrics.stage("fwd.fused_forward", flops=fused_flops):
+            if self.mesh is not None and _use_shard_map(self.config):
+                stacked = sharded.forward_all_sharded(
+                    self.core, self.mesh, self._get_BF_Fs(), self._offs0,
+                    self._offs1, col_offs0, sg_offs1, size, masks0, masks1,
+                )
+            else:
+                stacked = batched.forward_all_batch(
+                    self.core, self._get_BF_Fs(), self._offs0, self._offs1,
+                    col_offs0, sg_offs1, size, masks0, masks1,
+                )
         flat = stacked.reshape(
             (len(col_offs0) * max_S,) + stacked.shape[2:]
         )
@@ -721,14 +741,15 @@ class SwiftlyBackward:
             self._MNAF_BMNAFs = self._zeros(
                 (len(self.stack), self.core.yN_size, self.stack.size)
             )
-        facets = batched.finish_facets_batch(
-            self.core,
-            self._MNAF_BMNAFs,
-            self._offs0,
-            self._masks0,
-            self.stack.size,
-        )
-        self.queue.drain()
+        with _metrics.stage("bwd.finish"):
+            facets = batched.finish_facets_batch(
+                self.core,
+                self._MNAF_BMNAFs,
+                self._offs0,
+                self._masks0,
+                self.stack.size,
+            )
+            self.queue.drain()
         self._finished = True
         return facets[: self.stack.n_real]
 
@@ -781,14 +802,28 @@ def backward_all(swiftly_config, facet_configs, subgrid_tasks):
     offs1 = _place(core, mesh, stack.offs1, True)
     masks0 = _place(core, mesh, stack.masks0, True)
     masks1 = _place(core, mesh, stack.masks1, True)
-    if mesh is not None and _use_shard_map(swiftly_config):
-        facets = sharded.backward_all_sharded(
-            core, mesh, subgrids, sg_offs, offs0, offs1,
-            masks0, masks1, stack.size,
+    fused_flops = 0
+    if _metrics.enabled():
+        from .utils.flops import backward_batched_flops
+
+        n_cols = len(groups)
+        fused_flops = backward_batched_flops(
+            core,
+            n_facets=stack.n_real,
+            facet_size=stack.size,
+            n_columns=n_cols,
+            subgrids_per_column=len(next(iter(groups.values()))),
+            subgrid_size=subgrid_tasks[0][0].size,
         )
-    else:
-        facets = batched.backward_all_batch(
-            core, subgrids, sg_offs, offs0, offs1, masks0, masks1,
-            stack.size,
-        )
+    with _metrics.stage("bwd.fused_backward", flops=fused_flops):
+        if mesh is not None and _use_shard_map(swiftly_config):
+            facets = sharded.backward_all_sharded(
+                core, mesh, subgrids, sg_offs, offs0, offs1,
+                masks0, masks1, stack.size,
+            )
+        else:
+            facets = batched.backward_all_batch(
+                core, subgrids, sg_offs, offs0, offs1, masks0, masks1,
+                stack.size,
+            )
     return facets[: stack.n_real]

@@ -1,0 +1,38 @@
+"""Structured run telemetry: metrics, provenance, progress.
+
+The reference pipeline reads its visibility off Dask's performance
+reports and worker transfer logs (reference scripts/utils.py:166-231);
+this package is the TPU port's equivalent substrate, designed so every
+perf artifact this repo emits is *measured, attributed and auditable*:
+
+* ``obs.metrics`` — a near-zero-overhead metrics registry (counters,
+  gauges, stage timers with min/mean/max/p99). Disabled (the default)
+  every instrumentation site costs one attribute check; enabled, each
+  stage pairs a host wall-clock timer with a
+  ``jax.profiler.TraceAnnotation`` of the SAME name, so Perfetto traces
+  and host metrics index by one stage vocabulary. Optional JSONL event
+  log + dict export with per-stage analytic FLOPs/MFU.
+* ``obs.manifest`` — the run-provenance record (device kind, SWIFTLY_*
+  env knobs, git SHA, config hash, ``baseline_source``) stamped into
+  every BENCH artifact, plus the artifact schema validator the
+  ``bench.py --smoke`` leg runs.
+* ``obs.heartbeat`` — progress reporting for hour-scale runs
+  (units/s, ETA) and incremental partial-artifact flushing so a killed
+  run still leaves its finished legs on disk.
+
+Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
+``SWIFTLY_METRICS_JSONL``) or programmatically with
+``metrics.enable(...)``. See docs/observability.md.
+"""
+
+from . import metrics
+from .heartbeat import Heartbeat, PartialArtifactWriter
+from .manifest import run_manifest, validate_artifact
+
+__all__ = [
+    "Heartbeat",
+    "PartialArtifactWriter",
+    "metrics",
+    "run_manifest",
+    "validate_artifact",
+]

@@ -54,6 +54,18 @@ from .batched import (
 )
 from .mesh import FACET_AXIS, varying
 
+def _scoped(name, fn):
+    """Wrap a kernel body in ``jax.named_scope`` so its compiled HLO ops
+    carry the stage name (shared vocabulary with the host-side stage
+    timers in ``obs.metrics``; zero runtime cost — trace-time only)."""
+
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
 __all__ = [
     "backward_all_sharded",
     "forward_all_sharded",
@@ -83,7 +95,7 @@ def _forward_kernel(core, mesh, subgrid_size: int):
         )
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/fwd.column_pass", body),
         mesh=mesh,
         in_specs=(P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P()),
         out_specs=P(),
@@ -123,7 +135,7 @@ def _backward_kernel(core, mesh):
         return jax.vmap(extract)(offs0, offs1)
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/bwd.column_pass", body),
         mesh=mesh,
         in_specs=(P(), P(), P(FACET_AXIS), P(FACET_AXIS)),
         out_specs=P(FACET_AXIS),
@@ -194,7 +206,7 @@ def _forward_column_kernel(core, mesh, subgrid_size: int):
         )
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/fwd.column_pass", body),
         mesh=mesh,
         in_specs=(
             P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
@@ -250,7 +262,7 @@ def _forward_all_kernel(core, mesh, subgrid_size: int):
         return subgrids
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/fwd.fused_forward", body),
         mesh=mesh,
         in_specs=(
             P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
@@ -294,7 +306,7 @@ def _backward_column_kernel(core, mesh):
         )
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/bwd.column_pass", body),
         mesh=mesh,
         in_specs=(
             P(), P(), P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS),
@@ -366,7 +378,7 @@ def _backward_all_kernel(core, mesh, facet_size: int):
         return _finish_facets_fn(core, MNAF_BMNAFs, offs0, masks0, facet_size)
 
     mapped = _shard_map(
-        body,
+        _scoped("swiftly/bwd.fused_backward", body),
         mesh=mesh,
         in_specs=(
             P(), P(), P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS),

@@ -136,6 +136,22 @@ except ImportError:  # pragma: no cover - older jax
 
 from .mesh import FACET_AXIS, mesh_size as _mesh_size, varying  # noqa: E402
 
+from ..obs import metrics as _metrics  # noqa: E402
+
+
+def _scoped(name, fn):
+    """Wrap a stage body in ``jax.named_scope`` so its compiled HLO ops
+    carry the stage name — the trace-side half of the shared stage
+    vocabulary (the host-side half is ``obs.metrics``' TraceAnnotation
+    of the same name minus the "swiftly/" prefix). Zero runtime cost:
+    the scope exists only at trace time, as op-name metadata."""
+
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
 
 # ---------------------------------------------------------------------------
 # Stage programs
@@ -182,14 +198,16 @@ def _facet_pass_fwd_fn(core):
 
 @functools.lru_cache(maxsize=None)
 def _facet_pass_fwd_j(core):
-    return _jit()(_facet_pass_fwd_fn(core))
+    return _jit()(
+        _scoped("swiftly/fwd.facet_pass", _facet_pass_fwd_fn(core))
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _facet_pass_fwd_sharded(core, mesh):
     """Facet-sharded forward facet pass (all ops shard-local)."""
     return _shmap(
-        _facet_pass_fwd_fn(core), mesh,
+        _scoped("swiftly/fwd.facet_pass", _facet_pass_fwd_fn(core)), mesh,
         in_specs=(_P(FACET_AXIS), _P(FACET_AXIS), _P()),
         out_specs=_P(None, FACET_AXIS),
     )
@@ -448,13 +466,22 @@ def _column_pass_fwd_fft_fn(core, subgrid_size, axis_name=None, finish=True):
 
 @functools.lru_cache(maxsize=None)
 def _column_pass_fwd_j(core, subgrid_size):
-    return _jit()(_column_pass_fwd_fn(core, subgrid_size))
+    return _jit()(
+        _scoped(
+            "swiftly/fwd.column_pass",
+            _column_pass_fwd_fn(core, subgrid_size),
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _column_pass_fwd_sharded(core, mesh, subgrid_size):
     return _shmap(
-        _column_pass_fwd_fn(core, subgrid_size, axis_name=FACET_AXIS), mesh,
+        _scoped(
+            "swiftly/fwd.column_pass",
+            _column_pass_fwd_fn(core, subgrid_size, axis_name=FACET_AXIS),
+        ),
+        mesh,
         in_specs=(
             _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
             _P(), _P(), _P(),
@@ -510,13 +537,23 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _column_pass_fwd_group_j(core, subgrid_size):
-    return _jit()(_column_pass_fwd_group_fn(core, subgrid_size))
+    return _jit()(
+        _scoped(
+            "swiftly/fwd.column_pass",
+            _column_pass_fwd_group_fn(core, subgrid_size),
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
     return _shmap(
-        _column_pass_fwd_group_fn(core, subgrid_size, axis_name=FACET_AXIS),
+        _scoped(
+            "swiftly/fwd.column_pass",
+            _column_pass_fwd_group_fn(
+                core, subgrid_size, axis_name=FACET_AXIS
+            ),
+        ),
         mesh,
         in_specs=(
             _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
@@ -770,7 +807,12 @@ def _column_pass_bwd_fft_fn(core, facet_size, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _column_pass_bwd_j(core, facet_size):
-    return _jit()(_column_pass_bwd_fn(core, facet_size))
+    return _jit()(
+        _scoped(
+            "swiftly/bwd.column_pass",
+            _column_pass_bwd_fn(core, facet_size),
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -781,7 +823,10 @@ def _column_pass_bwd_group_j(core, facet_size):
     cost of the backward leg (measured ~0.1 s per chain)."""
     fn = _column_pass_bwd_fn(core, facet_size)
     return _jit()(
-        jax.vmap(fn, in_axes=(0, 0, None, None, None))
+        _scoped(
+            "swiftly/bwd.column_pass",
+            jax.vmap(fn, in_axes=(0, 0, None, None, None)),
+        )
     )
 
 
@@ -790,7 +835,11 @@ def _column_pass_bwd_sharded(core, mesh, facet_size):
     """Facet-sharded backward column pass (subgrids replicated; the split
     and fold are shard-local, no collectives)."""
     return _shmap(
-        _column_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS), mesh,
+        _scoped(
+            "swiftly/bwd.column_pass",
+            _column_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS),
+        ),
+        mesh,
         in_specs=(
             _P(), _P(), _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS),
         ),
@@ -829,13 +878,22 @@ def _facet_pass_bwd_fn(core, facet_size, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _facet_pass_bwd_j(core, facet_size):
-    return _jit()(_facet_pass_bwd_fn(core, facet_size))
+    return _jit()(
+        _scoped(
+            "swiftly/bwd.facet_pass",
+            _facet_pass_bwd_fn(core, facet_size),
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _facet_pass_bwd_sharded(core, mesh, facet_size):
     return _shmap(
-        _facet_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS), mesh,
+        _scoped(
+            "swiftly/bwd.facet_pass",
+            _facet_pass_bwd_fn(core, facet_size, axis_name=FACET_AXIS),
+        ),
+        mesh,
         in_specs=(
             _P(None, FACET_AXIS), _P(), _P(FACET_AXIS), _P(FACET_AXIS),
         ),
@@ -1041,7 +1099,12 @@ def _facet_pass_sampled_fn(core, real_facets=False):
 
 @functools.lru_cache(maxsize=None)
 def _facet_pass_sampled_j(core, real_facets=False):
-    return _jit()(_facet_pass_sampled_fn(core, real_facets))
+    return _jit()(
+        _scoped(
+            "swiftly/fwd.sampled_facet_pass",
+            _facet_pass_sampled_fn(core, real_facets),
+        )
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -1055,7 +1118,11 @@ def _facet_pass_sampled_sharded(core, mesh, real_facets=False):
         n_arrays = 2 if _planar(core) else 1  # planes vs complex facets
     in_specs = tuple([_P(FACET_AXIS)] * n_arrays) + (_P(FACET_AXIS), _P())
     return _shmap(
-        _facet_pass_sampled_fn(core, real_facets), mesh,
+        _scoped(
+            "swiftly/fwd.sampled_facet_pass",
+            _facet_pass_sampled_fn(core, real_facets),
+        ),
+        mesh,
         in_specs=in_specs,
         out_specs=_P(FACET_AXIS),
     )
@@ -1231,7 +1298,9 @@ def _bwd_sampled_fold_fn(core):
 
 @functools.lru_cache(maxsize=None)
 def _bwd_sampled_fold_j(core):
-    return _jit(donate=(0,))(_bwd_sampled_fold_fn(core))
+    return _jit(donate=(0,))(
+        _scoped("swiftly/bwd.sampled_fold", _bwd_sampled_fold_fn(core))
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -1250,7 +1319,7 @@ def _sampled_finish_j(core):
             m = m[..., None]
         return acc * m
 
-    return _jit(donate=(0,))(fn)
+    return _jit(donate=(0,))(_scoped("swiftly/bwd.finish", fn))
 
 
 @functools.lru_cache(maxsize=None)
@@ -1258,7 +1327,8 @@ def _bwd_sampled_fold_sharded(core, mesh):
     """Facet-sharded fold: each device updates its local facets' image
     accumulator (no collectives — rows and acc share the facet axis)."""
     return _shmap(
-        _bwd_sampled_fold_fn(core), mesh,
+        _scoped("swiftly/bwd.sampled_fold", _bwd_sampled_fold_fn(core)),
+        mesh,
         in_specs=(_P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P()),
         out_specs=_P(FACET_AXIS),
         donate=(0,),
@@ -1367,7 +1437,9 @@ def _bwd_fft_fold_chunk_fn(core, Cj, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _bwd_fft_fold_chunk_j(core, Cj):
-    return _jit(donate=(0,))(_bwd_fft_fold_chunk_fn(core, Cj))
+    return _jit(donate=(0,))(
+        _scoped("swiftly/bwd.fft_fold", _bwd_fft_fold_chunk_fn(core, Cj))
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -1375,7 +1447,11 @@ def _bwd_fft_fold_chunk_sharded(core, mesh, Cj):
     """Facet-sharded FFT fold chunk (embed + fft are facet-local; no
     collectives — rows and acc share the facet axis)."""
     return _shmap(
-        _bwd_fft_fold_chunk_fn(core, Cj, axis_name=FACET_AXIS), mesh,
+        _scoped(
+            "swiftly/bwd.fft_fold",
+            _bwd_fft_fold_chunk_fn(core, Cj, axis_name=FACET_AXIS),
+        ),
+        mesh,
         in_specs=(
             _P(FACET_AXIS), _P(None, FACET_AXIS), _P(), _P(FACET_AXIS),
             _P(), _P(),
@@ -1611,14 +1687,20 @@ def _bwd_ct_fold_fn(core, Q, P, kmax, W, axis_name=None):
 
 @functools.lru_cache(maxsize=None)
 def _bwd_ct_fold_j(core, Q, P, kmax, W):
-    return _jit(donate=(0,))(_bwd_ct_fold_fn(core, Q, P, kmax, W))
+    return _jit(donate=(0,))(
+        _scoped("swiftly/bwd.ct_fold", _bwd_ct_fold_fn(core, Q, P, kmax, W))
+    )
 
 
 @functools.lru_cache(maxsize=None)
 def _bwd_ct_fold_sharded(core, mesh, Q, P, kmax, W):
     """Facet-sharded CT fold (all stages facet-local; no collectives)."""
     return _shmap(
-        _bwd_ct_fold_fn(core, Q, P, kmax, W, axis_name=FACET_AXIS), mesh,
+        _scoped(
+            "swiftly/bwd.ct_fold",
+            _bwd_ct_fold_fn(core, Q, P, kmax, W, axis_name=FACET_AXIS),
+        ),
+        mesh,
         in_specs=(
             _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P(),
             _P(), _P(), _P(),
@@ -1672,7 +1754,7 @@ def _synth_slab_j(core, Fg, yB):
         z = jnp.zeros((Fg, yB, yB), dtype=dt)
         return z.at[f, r, c].add(v)
 
-    return _jit()(fn)
+    return _jit()(_scoped("swiftly/fwd.facet_synth", fn))
 
 
 # -- facet-group forward column step ----------------------------------------
@@ -1754,7 +1836,10 @@ def _column_group_step_fn(core, subgrid_size, chunk, colpass):
 @functools.lru_cache(maxsize=None)
 def _column_group_step_j(core, subgrid_size, chunk, colpass):
     return _jit(donate=(0,))(
-        _column_group_step_fn(core, subgrid_size, chunk, colpass)
+        _scoped(
+            "swiftly/fwd.slab_step",
+            _column_group_step_fn(core, subgrid_size, chunk, colpass),
+        )
     )
 
 
@@ -1783,7 +1868,7 @@ def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB, colpass):
         buf = sam(slab, e0, krows)
         return step(acc, buf, foffs0, foffs1, so_c)
 
-    return _jit(donate=(0,))(fn)
+    return _jit(donate=(0,))(_scoped("swiftly/fwd.slab_step", fn))
 
 
 def _column_group_finish_fn(core, subgrid_size, colpass):
@@ -1817,7 +1902,10 @@ def _column_group_finish_fn(core, subgrid_size, colpass):
 @functools.lru_cache(maxsize=None)
 def _column_group_finish_j(core, subgrid_size, colpass):
     return _jit(donate=(0,))(
-        _column_group_finish_fn(core, subgrid_size, colpass)
+        _scoped(
+            "swiftly/fwd.group_finish",
+            _column_group_finish_fn(core, subgrid_size, colpass),
+        )
     )
 
 
@@ -2091,15 +2179,22 @@ class StreamedForward:
         Cb = base.col_block
         pending = []  # (j0, device result) — simple 2-deep pipeline
         for j0 in range(0, base._yB_pad, Cb):
-            out = fwd(
-                base._place(self._facet_block(j0)), base._foffs0, col_offs0_j
-            )
+            with _metrics.stage("fwd.facet_pass") as st:
+                block = self._facet_block(j0)
+                st.bytes_moved = int(block.nbytes)  # h2d upload volume
+                out = fwd(base._place(block), base._foffs0, col_offs0_j)
             pending.append((j0, out))
             if len(pending) > 1:
                 pj, pout = pending.pop(0)
-                buf[:, :, :, pj : pj + Cb] = np.asarray(pout)
+                with _metrics.stage("fwd.d2h") as st:
+                    host = np.asarray(pout)
+                    st.bytes_moved = int(host.nbytes)
+                buf[:, :, :, pj : pj + Cb] = host
         for pj, pout in pending:
-            buf[:, :, :, pj : pj + Cb] = np.asarray(pout)
+            with _metrics.stage("fwd.d2h") as st:
+                host = np.asarray(pout)
+                st.bytes_moved = int(host.nbytes)
+            buf[:, :, :, pj : pj + Cb] = host
         self._nmbf = buf
         self._col_index = {int(off0): k for k, off0 in enumerate(col_offs0)}
 
@@ -2190,14 +2285,20 @@ class StreamedForward:
         if device_arrays:
             yield from gen
             return
+        def pull(arr):
+            with _metrics.stage("fwd.d2h") as st:
+                host = np.asarray(arr)
+                st.bytes_moved = int(host.nbytes)
+            return host
+
         pending = []
         for items, out in gen:
             pending.append((items, out))
             if len(pending) > 1:
                 pitems, pout = pending.pop(0)
-                yield pitems, np.asarray(pout)
+                yield pitems, pull(pout)
         for pitems, pout in pending:
-            yield pitems, np.asarray(pout)
+            yield pitems, pull(pout)
 
     def _host_columns(self, groups, colfn):
         """Host-buffered NMBF_all: FFT facet pass + per-column upload."""
@@ -2206,30 +2307,44 @@ class StreamedForward:
             int(o) not in self._col_index for o in col_offs0
         ):
             self._build_nmbf(col_offs0)
+        cp_flops = coll_bytes = 0
+        if _metrics.enabled():
+            from ..utils.flops import column_pass_flops
+            from ..utils.profiling import column_collective_bytes
+
+            base = self._base
+            first = next(iter(groups.values()))
+            colpass = _resolve_colpass(
+                self.core, base.stack.n_total // _mesh_size(base.mesh)
+            )
+            cp_flops = column_pass_flops(
+                self.core, base.stack.n_real, len(first),
+                first[0][1].size, colpass,
+            )
+            coll_bytes = column_collective_bytes(
+                self.core, _mesh_size(base.mesh), len(first), "forward"
+            )
         for off0 in col_offs0:
             prog_items = groups[off0]  # incl. zero-mask padding at the end
             items = [it for it in prog_items if it[0] is not None]
-            NMBF = self._nmbf_column(self._col_index[int(off0)])
-            yield items, self._column_program(colfn, NMBF, prog_items)
+            with _metrics.stage("fwd.h2d") as st:
+                NMBF = self._nmbf_column(self._col_index[int(off0)])
+                st.bytes_moved = int(getattr(NMBF, "nbytes", 0))
+            with _metrics.stage(
+                "fwd.column_pass", flops=cp_flops, bytes_moved=coll_bytes
+            ):
+                out = self._column_program(colfn, NMBF, prog_items)
+            yield items, out
 
-    def _device_columns(self, groups, subgrid_size, whole_groups=False):
-        """Facets-resident sampled-DFT pass in column groups.
-
-        Facets upload ONCE and stay on device; each group of G columns'
-        contribution rows is one einsum dispatch (compute proportional to
-        the rows extracted, so chunking is free), and the group's G
-        column passes run as ONE vmapped dispatch; nothing round-trips
-        through the host. Device residency = facets + one [F, G*m, yB]
-        group buffer + two in-flight [G, S, xA, xA] output stacks.
-        """
-        import jax
-        import jax.numpy as jnp
-
+    def _upload_resident_facets(self):
+        """Upload (or device-synthesise) the resident facet stack for the
+        sampled path — the one-time h2d cost of residency='device',
+        recorded as the `fwd.facet_upload` stage."""
         base = self._base
         core = base.core
         yB = base.stack.size
         n_pad = base.stack.n_total - base.stack.n_real
-        if self._dev_facets is None:
+        with _metrics.stage("fwd.facet_upload") as st:
             if self._facets_sparse:
                 # synthesise the resident stack on device: kilobytes of
                 # coordinates uploaded instead of the multi-GB planes
@@ -2270,6 +2385,28 @@ class StreamedForward:
                         )
                     ),
                 )
+            st.bytes_moved = sum(
+                int(getattr(a, "nbytes", 0)) for a in self._dev_facets
+            )
+
+    def _device_columns(self, groups, subgrid_size, whole_groups=False):
+        """Facets-resident sampled-DFT pass in column groups.
+
+        Facets upload ONCE and stay on device; each group of G columns'
+        contribution rows is one einsum dispatch (compute proportional to
+        the rows extracted, so chunking is free), and the group's G
+        column passes run as ONE vmapped dispatch; nothing round-trips
+        through the host. Device residency = facets + one [F, G*m, yB]
+        group buffer + two in-flight [G, S, xA, xA] output stacks.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        base = self._base
+        core = base.core
+        yB = base.stack.size
+        if self._dev_facets is None:
+            self._upload_resident_facets()
         e0 = base._place(
             (base.stack.offs0 - yB // 2).astype(np.int32)
         )
@@ -2297,6 +2434,27 @@ class StreamedForward:
         from ..api import _subgrid_masks
 
         rdt = core._Fb.dtype
+        fp_flops = cp_flops = coll_bytes = 0
+        if _metrics.enabled():
+            from ..utils.flops import (
+                column_pass_flops,
+                sampled_facet_pass_flops,
+            )
+            from ..utils.profiling import column_collective_bytes
+
+            _metrics.gauge("fwd.plan", dict(self.last_plan))
+            S = len(next(iter(groups.values())))
+            fp_flops = sampled_facet_pass_flops(
+                core, base.stack.n_real, yB, G * core.xM_yN_size,
+                self._facets_real,
+            )
+            cp_flops = G * column_pass_flops(
+                core, base.stack.n_real, S, subgrid_size,
+                self.last_plan["colpass"],
+            )
+            coll_bytes = G * column_collective_bytes(
+                core, _mesh_size(base.mesh), S, "forward"
+            )
         prev_tail = None  # backpressure marker: group g-1's output stack
         for g0 in range(0, len(col_offs0), G):
             grp = col_offs0[g0 : g0 + G]
@@ -2321,17 +2479,33 @@ class StreamedForward:
             # block_until_ready returns before the queue drains, so pull
             # an 8-byte checksum of the previous group instead.
             if prev_tail is not None:
-                np.asarray(prev_tail)
-            buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
-            out_g = gcolfn(
-                buf,
-                base._foffs0,
-                base._foffs1,
-                jnp.asarray(sg_offs_g),
-                jnp.asarray(np.asarray(m0_g), rdt),
-                jnp.asarray(np.asarray(m1_g), rdt),
-            )  # [G, S, xA, xA(,2)]
+                with _metrics.stage("fwd.drain"):
+                    np.asarray(prev_tail)
+            with _metrics.stage("fwd.sampled_facet_pass", flops=fp_flops):
+                buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
+            with _metrics.stage(
+                "fwd.column_pass", flops=cp_flops, bytes_moved=coll_bytes
+            ):
+                out_g = gcolfn(
+                    buf,
+                    base._foffs0,
+                    base._foffs1,
+                    jnp.asarray(sg_offs_g),
+                    jnp.asarray(np.asarray(m0_g), rdt),
+                    jnp.asarray(np.asarray(m1_g), rdt),
+                )  # [G, S, xA, xA(,2)]
             prev_tail = jnp.sum(out_g)
+            if _metrics.enabled():
+                _metrics.count(
+                    "fwd.subgrids",
+                    sum(
+                        1
+                        for off0 in grp
+                        for it in groups[off0]
+                        if it[0] is not None
+                    ),
+                )
+                _metrics.count("fwd.column_groups")
             if whole_groups:
                 yield _whole_group_yield(groups, grp, G, out_g)
                 continue
@@ -2443,6 +2617,27 @@ class StreamedForward:
             ),
             "colpass": colpass,
         }
+        fp_flops = step_flops = coll_bytes = 0
+        if _metrics.enabled():
+            from ..utils.flops import (
+                column_pass_flops,
+                sampled_facet_pass_flops,
+            )
+            from ..utils.profiling import column_collective_bytes
+
+            _metrics.gauge("fwd.plan", dict(self.last_plan))
+            fp_flops = sampled_facet_pass_flops(
+                core, Fg, yB, G * core.xM_yN_size, self._facets_real
+            )
+            # the whole column-pass pipeline's FLOPs attributed to the
+            # slab step (the group finish's iFFT/crop share is folded in
+            # — the two stages are one pipeline split only for memory)
+            step_flops = G * column_pass_flops(
+                core, Fg, S, subgrid_size, colpass
+            )
+            coll_bytes = G * column_collective_bytes(
+                core, _mesh_size(base.mesh), S, "forward"
+            )
 
         # per-slab facet metadata, padded with zero facets to F_pad
         offs0 = np.concatenate(
@@ -2538,7 +2733,8 @@ class StreamedForward:
             slab_dev = None
             for s0 in range(0, F_pad, Fg):
                 while len(pending) >= depth:
-                    np.asarray(pending.popleft())
+                    with _metrics.stage("fwd.drain"):
+                        np.asarray(pending.popleft())
                 # drop the previous slab BEFORE uploading the next: at
                 # depth 1 both must never be live together
                 # parity from a CONTINUOUS dispatch counter, not the
@@ -2549,32 +2745,49 @@ class StreamedForward:
                 slab_dev = None  # noqa: F841 - releases device buffers
                 if fusedfn is not None:
                     # one dispatch: synth + sampled pass + column step
-                    acc = fusedfn(
-                        acc,
-                        *self._sparse_pixels(s0, s0 + Fg),
-                        jnp.asarray(e0[s0 : s0 + Fg]),
-                        krows,
-                        jnp.asarray(offs0[s0 : s0 + Fg]),
-                        jnp.asarray(offs1[s0 : s0 + Fg]),
-                        so_c,
-                    )
+                    with _metrics.stage(
+                        "fwd.slab_step",
+                        flops=fp_flops + step_flops,
+                        bytes_moved=coll_bytes,
+                    ):
+                        acc = fusedfn(
+                            acc,
+                            *self._sparse_pixels(s0, s0 + Fg),
+                            jnp.asarray(e0[s0 : s0 + Fg]),
+                            krows,
+                            jnp.asarray(offs0[s0 : s0 + Fg]),
+                            jnp.asarray(offs1[s0 : s0 + Fg]),
+                            so_c,
+                        )
                 else:
-                    slab_dev = tuple(
-                        base._place(a)
-                        for a in host_slab(s0, n_slab_dispatch % 2)
-                    )
-                    buf = samfn(
-                        *slab_dev,
-                        jnp.asarray(e0[s0 : s0 + Fg]),
-                        krows,
-                    )
-                    acc = stepfn(
-                        acc,
-                        buf,
-                        jnp.asarray(offs0[s0 : s0 + Fg]),
-                        jnp.asarray(offs1[s0 : s0 + Fg]),
-                        so_c,
-                    )
+                    with _metrics.stage("fwd.slab_upload") as st:
+                        slab_dev = tuple(
+                            base._place(a)
+                            for a in host_slab(s0, n_slab_dispatch % 2)
+                        )
+                        st.bytes_moved = sum(
+                            int(a.nbytes) for a in slab_dev
+                        )
+                    with _metrics.stage(
+                        "fwd.sampled_facet_pass", flops=fp_flops
+                    ):
+                        buf = samfn(
+                            *slab_dev,
+                            jnp.asarray(e0[s0 : s0 + Fg]),
+                            krows,
+                        )
+                    with _metrics.stage(
+                        "fwd.slab_step",
+                        flops=step_flops,
+                        bytes_moved=coll_bytes,
+                    ):
+                        acc = stepfn(
+                            acc,
+                            buf,
+                            jnp.asarray(offs0[s0 : s0 + Fg]),
+                            jnp.asarray(offs1[s0 : s0 + Fg]),
+                            so_c,
+                        )
                 n_slab_dispatch += 1
                 pending.append(jnp.sum(acc))
                 if logger.isEnabledFor(logging.INFO):
@@ -2589,8 +2802,20 @@ class StreamedForward:
             # finished array replaces it; the runtime orders the finish
             # after the pending slab steps on the same buffer — the
             # depth-2 checksum pipeline keeps bounding live slabs)
-            finished = finfn(acc, so_c, m0_c, m1_c)
+            with _metrics.stage("fwd.group_finish"):
+                finished = finfn(acc, so_c, m0_c, m1_c)
             del acc
+            if _metrics.enabled():
+                _metrics.count(
+                    "fwd.subgrids",
+                    sum(
+                        1
+                        for off0 in grp
+                        for it in groups[off0]
+                        if it[0] is not None
+                    ),
+                )
+                _metrics.count("fwd.column_groups")
             if whole_groups:
                 flat = finished.reshape((G,) + finished.shape[2:])
                 yield _whole_group_yield(groups, grp, G, flat)
@@ -2682,6 +2907,17 @@ def grouped_col_group_for_budget(
     and a trig/fragmentation reserve. ``warn=False`` evaluates quietly —
     the executor's (G, chunk) sweep probes chunks it may not select and
     re-warns only for the chosen pair.
+
+    CALIBRATION BASIS (r5): the consumer-transient term was relaxed from
+    3x to 2x [S, xA, xA] against measured 128k boundaries on a 16 GiB
+    v5e — G=4 streams green where the 3x model allowed only G=2, and
+    the OOM edge sits at G=6 with two groups in flight. Configs between
+    the calibrated points sit closer to that edge, with the bench's
+    `_oom_soft` shrink-and-retry as the backstop; the operator escape
+    hatch is ``SWIFTLY_HBM_BUDGET`` (explicit byte budget — lower it to
+    move any config away from the edge, raise it on bigger-HBM parts).
+    See docs/observability.md for how to read the plan gauges a run
+    records.
     """
     core = base.core
     dsize = np.dtype(core.dtype).itemsize * (2 if _planar(core) else 1)
@@ -2855,6 +3091,22 @@ class StreamedBackward:
         self._rows_inflight = collections.deque()
         self._finished = False
 
+    def _bwd_cp_flops(self, n_subgrids, subgrid_size):
+        """Analytic FLOPs of one backward column pass over `n_subgrids`
+        (stage attribution; 0 when metrics are disabled)."""
+        if not _metrics.enabled():
+            return 0
+        from ..utils.flops import bwd_column_pass_flops
+
+        base = self._base
+        colpass = _resolve_colpass_bwd(
+            self.core, base.stack.n_total // _mesh_size(base.mesh)
+        )
+        return bwd_column_pass_flops(
+            self.core, base.stack.n_real, n_subgrids, base.stack.size,
+            subgrid_size, colpass,
+        )
+
     def add_subgrids(self, tasks):
         """Fold (SubgridConfig, subgrid_data) pairs into the accumulators."""
         if self._finished:
@@ -2888,12 +3140,14 @@ class StreamedBackward:
             )
         off0 = off0s.pop()
         yB = base.stack.size
+        h2d_bytes = 0
         if hasattr(subgrids, "sharding"):  # already a placed jax array
             subgrids = jnp.asarray(subgrids)
         else:
             subgrids = jnp.stack(
                 [jnp.asarray(_to_host_layout(core, d)) for d in subgrids]
             )
+            h2d_bytes = int(subgrids.nbytes)
         sg_offs = jnp.asarray([(sg.off0, sg.off1) for sg in sg_configs])
         if base.mesh is not None:
             colfn = _column_pass_bwd_sharded(core, base.mesh, yB)
@@ -2903,14 +3157,29 @@ class StreamedBackward:
             # genuine completion pull of the column before last (8-byte
             # host round trip) before dispatching another column pass
             while len(self._rows_inflight) >= 2:
-                np.asarray(self._rows_inflight.popleft())
-        rows = colfn(
-            subgrids,
-            sg_offs,
-            base._foffs0,
-            base._foffs1,
-            base._masks1_dev,
-        )  # [F, m, yB] (facet-sharded on a mesh)
+                with _metrics.stage("bwd.drain"):
+                    np.asarray(self._rows_inflight.popleft())
+        cp_bytes = h2d_bytes
+        if _metrics.enabled():
+            from ..utils.profiling import column_collective_bytes
+
+            cp_bytes += column_collective_bytes(
+                core, _mesh_size(base.mesh), len(sg_configs), "backward",
+                subgrid_size=sg_configs[0].size,
+            )
+            _metrics.count("bwd.subgrids_folded", len(sg_configs))
+        with _metrics.stage(
+            "bwd.column_pass",
+            flops=self._bwd_cp_flops(len(sg_configs), sg_configs[0].size),
+            bytes_moved=cp_bytes,
+        ):
+            rows = colfn(
+                subgrids,
+                sg_offs,
+                base._foffs0,
+                base._foffs1,
+                base._masks1_dev,
+            )  # [F, m, yB] (facet-sharded on a mesh)
         key = int(off0)
         if base.residency == "sampled":
             self._rows_inflight.append(jnp.sum(rows[:, 0]))
@@ -2952,7 +3221,8 @@ class StreamedBackward:
         """Pull fold checksums down to `depth` in flight (genuine 8-byte
         host round trips — see _fold_inflight comment in __init__)."""
         while len(self._fold_inflight) > depth:
-            np.asarray(self._fold_inflight.popleft())
+            with _metrics.stage("bwd.drain"):
+                np.asarray(self._fold_inflight.popleft())
 
     def _fold_rows(self, offs, rows_cat):
         """("sampled") one adjoint fold of concatenated column rows
@@ -2988,17 +3258,26 @@ class StreamedBackward:
             else:
                 foldfn = _bwd_ct_fold_j(core, Q, P, kmax, W)
             ri, av = jnp.asarray(r_idx), jnp.asarray(a_vals)
-            for j0 in range(0, yB, W):
-                self._acc = foldfn(
-                    self._acc, rows_cat, e0, krows, ri, av,
-                    jnp.int32(j0),
-                )
+            with _metrics.stage("bwd.ct_fold"):
+                for j0 in range(0, yB, W):
+                    self._acc = foldfn(
+                        self._acc, rows_cat, e0, krows, ri, av,
+                        jnp.int32(j0),
+                    )
         else:
             if base.mesh is not None:
                 foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
             else:
                 foldfn = _bwd_sampled_fold_j(core)
-            self._acc = foldfn(self._acc, rows_cat, e0, krows)
+            fold_flops = 0
+            if _metrics.enabled():
+                from ..utils.flops import bwd_fold_flops
+
+                fold_flops = bwd_fold_flops(
+                    core, base.stack.n_real, yB, int(rows_cat.shape[1])
+                )
+            with _metrics.stage("bwd.sampled_fold", flops=fold_flops):
+                self._acc = foldfn(self._acc, rows_cat, e0, krows)
         # the checksum slice depends on the whole fold having executed
         self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
 
@@ -3020,13 +3299,14 @@ class StreamedBackward:
         else:
             foldfn = _bwd_fft_fold_chunk_j(core, Cj)
         self._drain_folds()
-        for ci in range(-(-yB // Cj)):
-            j0 = ci * Cj
-            start = min(j0, yB - Cj)
-            self._acc = foldfn(
-                self._acc, rows_g, offs_dev, base._foffs0,
-                jnp.int32(j0), jnp.int32(start),
-            )
+        with _metrics.stage("bwd.fft_fold"):
+            for ci in range(-(-yB // Cj)):
+                j0 = ci * Cj
+                start = min(j0, yB - Cj)
+                self._acc = foldfn(
+                    self._acc, rows_g, offs_dev, base._foffs0,
+                    jnp.int32(j0), jnp.int32(start),
+                )
         self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
 
     def _flush_folds(self):
@@ -3117,13 +3397,20 @@ class StreamedBackward:
             # worth — a separate rows pull would add one ~0.1 s tunnel
             # round trip per chunk for backpressure the fold already
             # provides (37 chunks = ~4 s of the 32k backward leg)
-            rows = colfn(
-                jnp.asarray(subgrids_group[j : j + cap]),
-                jnp.asarray(sg_offs_np[j : j + cap]),
-                base._foffs0,
-                base._foffs1,
-                base._masks1_dev,
-            )  # [g, F, m, yB(,2)]
+            g = len(offs[j : j + cap])
+            if _metrics.enabled():
+                _metrics.count("bwd.subgrids_folded", g * S)
+            with _metrics.stage(
+                "bwd.column_pass",
+                flops=g * self._bwd_cp_flops(S, int(subgrids_group.shape[2])),
+            ):
+                rows = colfn(
+                    jnp.asarray(subgrids_group[j : j + cap]),
+                    jnp.asarray(sg_offs_np[j : j + cap]),
+                    base._foffs0,
+                    base._foffs1,
+                    base._masks1_dev,
+                )  # [g, F, m, yB(,2)]
             if self._fold_mode == "fft":
                 # the FFT fold takes per-column rows directly; its cost
                 # is flat in g, so the whole chunk folds in one dispatch
@@ -3148,7 +3435,8 @@ class StreamedBackward:
             raise RuntimeError("No subgrids were added")
         fn = _sampled_finish_j(self.core)
         acc, self._acc = self._acc, None  # donated to the finish program
-        out = fn(acc, self._base._masks0_dev)
+        with _metrics.stage("bwd.finish"):
+            out = fn(acc, self._base._masks0_dev)
         self._finished = True
         return out
 
@@ -3195,15 +3483,22 @@ class StreamedBackward:
                     ),
                     facet_axis=1,
                 )
-            out = finfn(blocks, col_offs0_j, base._foffs0, masks0)
+            with _metrics.stage("bwd.facet_pass"):
+                out = finfn(blocks, col_offs0_j, base._foffs0, masks0)
             pending.append((j0, out))
             if len(pending) > 1:
                 pj, pout = pending.pop(0)
                 j1 = min(pj + Cb, yB)
-                facets[:, :, pj:j1] = np.asarray(pout)[:, :, : j1 - pj]
+                with _metrics.stage("bwd.d2h") as st:
+                    host = np.asarray(pout)
+                    st.bytes_moved = int(host.nbytes)
+                facets[:, :, pj:j1] = host[:, :, : j1 - pj]
         for pj, pout in pending:
             j1 = min(pj + Cb, yB)
             if j1 > pj:
-                facets[:, :, pj:j1] = np.asarray(pout)[:, :, : j1 - pj]
+                with _metrics.stage("bwd.d2h") as st:
+                    host = np.asarray(pout)
+                    st.bytes_moved = int(host.nbytes)
+                facets[:, :, pj:j1] = host[:, :, : j1 - pj]
         self._finished = True
         return facets[: stack.n_real]

@@ -10,15 +10,21 @@ from .checkpoint import (
 )
 from .flops import (
     backward_batched_flops,
+    backward_sampled_flops,
+    bwd_column_pass_flops,
+    bwd_fold_flops,
+    column_pass_flops,
     fft_flops,
     forward_batched_flops,
     forward_sampled_flops,
     peak_tflops,
+    sampled_facet_pass_flops,
 )
 from .profiling import (
     MemorySampler,
     collective_bytes_backward,
     collective_bytes_forward,
+    column_collective_bytes,
     device_memory_stats,
     trace,
 )
@@ -26,8 +32,13 @@ from .profiling import (
 __all__ = [
     "MemorySampler",
     "backward_batched_flops",
+    "backward_sampled_flops",
+    "bwd_column_pass_flops",
+    "bwd_fold_flops",
     "collective_bytes_backward",
     "collective_bytes_forward",
+    "column_collective_bytes",
+    "column_pass_flops",
     "device_memory_stats",
     "enable_compilation_cache",
     "fft_flops",
@@ -38,5 +49,6 @@ __all__ = [
     "restore_streamed_backward_state",
     "save_backward_state",
     "save_streamed_backward_state",
+    "sampled_facet_pass_flops",
     "trace",
 ]

@@ -20,7 +20,10 @@ import os
 from ..ops.planar_backend import _DIRECT_MAX, _factor
 
 __all__ = [
+    "bwd_column_pass_flops",
+    "bwd_fold_flops",
     "colpass_mode",
+    "column_pass_flops",
     "fft_flops",
     "forward_batched_flops",
     "forward_sampled_flops",
@@ -29,6 +32,7 @@ __all__ = [
     "peak_tflops",
     "resolve_colpass",
     "resolve_colpass_bwd",
+    "sampled_facet_pass_flops",
 ]
 
 
@@ -142,6 +146,70 @@ def _column_prepare_flops(core, n_facets: int, colpass: str = "fft") -> int:
     return base
 
 
+# -- per-stage counts (the obs instrumentation's attribution unit) ----------
+#
+# The whole-cover totals below are SUMS of these stage counts, so the
+# per-stage MFU the metrics registry reports and the artifact-level
+# tflops/mfu_pct the bench reports can never diverge: one formula per
+# stage, used by both.
+
+
+def sampled_facet_pass_flops(
+    core, n_facets: int, facet_size: int, n_rows: int,
+    real_facets: bool = False,
+) -> int:
+    """FLOPs of ONE sampled-DFT facet-pass einsum extracting `n_rows`
+    contribution rows from `n_facets` resident facets (the forward's
+    per-column-group dispatch; `n_rows` = G*m). ``real_facets`` halves
+    the matmuls (the zero imaginary plane's einsums are skipped)."""
+    yB = facet_size
+    mm = 4 if real_facets else 8
+    return mm * n_rows * yB * (n_facets * yB) + 6 * n_facets * n_rows * yB
+
+
+def column_pass_flops(
+    core, n_facets: int, n_subgrids: int, subgrid_size: int,
+    colpass: str = "fft",
+) -> int:
+    """FLOPs of ONE forward column pass: axis-1 preparation plus the
+    summation/finish of the column's `n_subgrids` subgrids, for the body
+    (`colpass`) the executor actually runs."""
+    return _column_prepare_flops(core, n_facets, colpass) + (
+        n_subgrids * _per_subgrid_flops(core, subgrid_size, n_facets, colpass)
+    )
+
+
+def bwd_column_pass_flops(
+    core, n_facets: int, n_subgrids: int, facet_size: int,
+    subgrid_size: int, colpass: str = "einsum",
+) -> int:
+    """FLOPs of ONE backward column pass (subgrid column -> NAF_BMNAF
+    rows): per-subgrid prepare/extract plus the per-column axis-1
+    finish, for the executed body."""
+    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+    if colpass == "einsum":
+        # two K=xM complex einsums per (subgrid, facet) plus the
+        # scatter-add into the [F, m, yN] accumulator
+        per_sg = n_facets * 8 * (m * xM * xM + m * m * xM)
+        per_sg += n_facets * 2 * m * yN
+    else:
+        # fft body: prepare (two ffts) + per-facet extraction
+        per_sg = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
+        per_sg += n_facets * (
+            fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
+        )
+    col_fin = n_facets * (fft_flops(yN, m) + 6 * m * facet_size)
+    return n_subgrids * per_sg + col_fin
+
+
+def bwd_fold_flops(core, n_facets: int, facet_size: int, n_rows: int) -> int:
+    """FLOPs of ONE adjoint sampled-DFT fold of `n_rows` concatenated
+    column rows into the [F, yB, yB] image accumulator (the backward's
+    per-fold-group dispatch; `n_rows` = P*m)."""
+    yB = facet_size
+    return 8 * n_rows * yB * (n_facets * yB) + 6 * n_facets * n_rows * yB
+
+
 def forward_batched_flops(
     core, n_facets: int, facet_size: int, n_columns: int,
     subgrids_per_column: int, subgrid_size: int,
@@ -186,8 +254,9 @@ def forward_sampled_flops(
     if colpass is None:
         colpass = resolve_colpass(core, n_facets)
     R = n_columns * m
-    mm = 4 if real_facets else 8
-    facet_pass = mm * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
+    facet_pass = sampled_facet_pass_flops(
+        core, n_facets, yB, R, real_facets=real_facets
+    )
     columns = n_columns * _column_prepare_flops(core, n_facets, colpass)
     subgrids = (
         n_columns
@@ -219,33 +288,16 @@ def backward_sampled_flops(
     adjoint sampled einsum: [R, yB_i]^T x [F, R, yB_j] over all R =
     n_columns*m rows, plus conjugate phases and the Fb weighting.
     """
-    m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
+    m = core.xM_yN_size
     yB = facet_size
     if colpass is None:
         colpass = resolve_colpass_bwd(core, n_facets)
-    if colpass == "einsum":
-        # two K=xM complex einsums per (subgrid, facet) — the prepare
-        # ffts live inside the E0/E1 operators — plus the per-subgrid
-        # scatter-add into the [F, m, yN] accumulator
-        per_sg = n_facets * 8 * (m * xM * xM + m * m * xM)
-        per_sg += n_facets * 2 * m * yN  # one complex accumulator add
-        prep = 0
-        extract = per_sg
-    else:
-        prep = fft_flops(xM, subgrid_size) + fft_flops(xM, xM)
-        extract = n_facets * (
-            fft_flops(m, m) + 6 * m * xM + fft_flops(m, m) + 6 * m * m
-        )
-    col_fin = n_facets * (fft_flops(yN, m) + 6 * m * yB)
-    R = n_columns * m
-    fold = 8 * R * yB * (n_facets * yB) + 6 * n_facets * R * yB
-    finish_mask = 2 * n_facets * yB * yB
-    return (
-        n_columns * subgrids_per_column * (prep + extract)
-        + n_columns * col_fin
-        + fold
-        + finish_mask
+    columns = n_columns * bwd_column_pass_flops(
+        core, n_facets, subgrids_per_column, yB, subgrid_size, colpass
     )
+    fold = bwd_fold_flops(core, n_facets, yB, n_columns * m)
+    finish_mask = 2 * n_facets * yB * yB
+    return columns + fold + finish_mask
 
 
 def backward_batched_flops(

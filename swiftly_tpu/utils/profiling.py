@@ -35,6 +35,7 @@ __all__ = [
     "MemorySampler",
     "collective_bytes_backward",
     "collective_bytes_forward",
+    "column_collective_bytes",
     "device_memory_stats",
     "probe_hbm_bytes",
     "trace",
@@ -283,3 +284,35 @@ def collective_bytes_backward(
     """
     buf = xA_size * xA_size * _itemsize(dtype, planar)
     return int(buf * (n_devices - 1))
+
+
+def column_collective_bytes(
+    core, n_devices: int, n_subgrids: int, direction: str = "forward",
+    subgrid_size: int | None = None,
+) -> int:
+    """Analytic wire bytes of ONE streamed column's collectives — the
+    per-stage transfer attribution the obs instrumentation stamps on
+    mesh column passes (zero off-mesh, so single-device stages carry no
+    phantom traffic).
+
+    Forward: one psum of the column's [S, xM, xM] partials (ring
+    all-reduce accounting, `collective_bytes_forward` per subgrid).
+    Backward: the column's subgrids broadcast to every facet shard
+    (`collective_bytes_backward`; requires `subgrid_size`).
+    """
+    if n_devices <= 1:
+        return 0
+    planar = core.backend == "planar"
+    if direction == "forward":
+        per = collective_bytes_forward(
+            core.xM_size, n_devices, core.dtype, planar
+        )
+    elif direction == "backward":
+        if subgrid_size is None:
+            raise ValueError("backward direction requires subgrid_size")
+        per = collective_bytes_backward(
+            subgrid_size, n_devices, core.dtype, planar
+        )
+    else:
+        raise ValueError(f"direction must be forward|backward, got {direction!r}")
+    return per * n_subgrids

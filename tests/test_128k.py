@@ -204,18 +204,33 @@ def test_bench_sparse_sources_inside_fov_cover():
     """Every spread bench source, rescaled for the sparse-FoV mode, must
     lie inside the circle of covered facet CENTRES for the catalogue's
     worst facet/image ratio (the code-review failure case: per-coordinate
-    bounding let corner sources escape the cover)."""
+    bounding let corner sources escape the cover).
+
+    The rescale divisor is DERIVED from the source table
+    (`_bench_source_radius`, ADVICE r5 finding 2) — this guard now
+    checks the derivation stays sound for any future edit to the spread
+    set, instead of pinning a hand-copied constant in two places."""
     import sys
     from pathlib import Path
 
     sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
-    from bench import _bench_sources
+    from bench import (
+        _BENCH_SOURCE_FRACTIONS,
+        _bench_source_radius,
+        _bench_sources,
+    )
 
+    rad = _bench_source_radius()
+    # the divisor really is the table's max radius (derivation, not a
+    # separately maintained constant)
+    assert rad == max(
+        (a * a + b * b) ** 0.5 for a, b in _BENCH_SOURCE_FRACTIONS
+    )
     for N, facet in [(131072, 13312), (32768, 11264), (131072, 45056)]:
         for fov in (0.6, 0.9):
             lim = max(fov / 2 - facet / (2 * N), 4 / N)
             for (_, r, c) in (
-                (w, int(r * lim / 0.56), int(c * lim / 0.56))
+                (w, int(r * lim / rad), int(c * lim / rad))
                 for (w, r, c) in _bench_sources(N)
             ):
                 assert (r * r + c * c) ** 0.5 <= lim * N + 1, (N, facet, fov, r, c)

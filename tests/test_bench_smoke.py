@@ -1,0 +1,69 @@
+"""The `bench.py --smoke` leg: the telemetry + artifact-schema contract,
+run exactly as the driver would (fresh subprocess, CPU), validating the
+ISSUE-1 acceptance shape end-to-end: a JSONL event log with >= 6
+distinct engine stage names, per-stage wall/MFU in the exported dict,
+and a BENCH-style artifact carrying the full run manifest with
+`baseline_source`.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_smoke_leg(tmp_path):
+    out = tmp_path / "BENCH_smoke.json"
+    jsonl = tmp_path / "BENCH_smoke.jsonl"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SMOKE_OUT=str(out),
+        SWIFTLY_METRICS_JSONL=str(jsonl),
+        BENCH_PARTIAL_PATH="",  # the smoke leg needs no partial file
+        # schema validation needs one pass, not a perf-grade number —
+        # keep the tier-1 budget: report the cold pass (flagged
+        # includes_compile in the artifact, as always)
+        BENCH_SKIP_WARM_PASS="1",
+    )
+    # a fresh interpreter: the smoke must pass from cold, the way the
+    # driver invokes it (no conftest x64/devices settings leak in)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["n_engine_stages"] >= 6
+
+    # re-validate the artifact here (the smoke's own validator passing
+    # is not proof the files landed with the promised content)
+    from swiftly_tpu.obs import validate_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_artifact(record) == []
+    assert record["baseline_source"] in ("measured", "operator", "estimated")
+    manifest = record["manifest"]
+    assert manifest["device"]["platform"] == "cpu"
+    assert manifest["git_sha"]
+    assert "SWIFTLY_PEAK_TFLOPS" in manifest["env"]
+    telemetry = record["telemetry"]
+    stages = telemetry["stages"]
+    engine = {s for s in stages if s.startswith(("fwd.", "bwd."))}
+    assert len(engine) >= 6, sorted(engine)
+    for entry in stages.values():
+        assert {"count", "total_s", "mean_s", "p99_s"} <= set(entry)
+    assert telemetry["total"]["mfu_pct"] > 0
+
+    names = {
+        r["name"]
+        for r in map(json.loads, jsonl.read_text().splitlines())
+        if r.get("kind") == "stage"
+    }
+    assert len({s for s in names if s.startswith(("fwd.", "bwd."))}) >= 6

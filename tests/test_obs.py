@@ -1,0 +1,329 @@
+"""The obs layer: registry semantics, JSONL round-trip, manifest
+completeness, and the streamed engine's stage-name contract.
+
+The registry under test is an isolated ``MetricsRegistry`` instance
+wherever possible; tests that exercise the ENGINE's instrumentation go
+through the process-global registry (the engine's call sites use it)
+and restore its state via the fixture below.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.obs import (
+    Heartbeat,
+    PartialArtifactWriter,
+    metrics,
+    run_manifest,
+    validate_artifact,
+)
+from swiftly_tpu.obs.metrics import MetricsRegistry, _NULL_STAGE
+
+
+@pytest.fixture
+def global_registry():
+    """The process-global registry, disabled and wiped afterwards."""
+    reg = metrics.get_registry()
+    yield reg
+    reg.disable()
+    reg.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_registry_is_a_no_op(tmp_path):
+    reg = MetricsRegistry()
+    # the disabled stage is the SHARED singleton — no per-call allocation
+    s1 = reg.stage("fwd.column_pass", flops=123)
+    s2 = reg.stage("bwd.sampled_fold")
+    assert s1 is _NULL_STAGE and s2 is _NULL_STAGE
+    with s1:
+        s1.bytes_moved = 42  # attribute writes are swallowed, not stored
+    reg.count("fwd.subgrids", 5)
+    reg.gauge("plan", {"col_group": 4})
+    reg.event("heartbeat", done=1)
+    exp = reg.export()
+    assert exp["counters"] == {} and exp["gauges"] == {}
+    assert exp["stages"] == {}
+    assert not exp["enabled"]
+
+
+def test_disabled_stage_call_overhead_is_negligible():
+    reg = MetricsRegistry()
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with reg.stage("fwd.column_pass"):
+            pass
+    per_call = (time.perf_counter() - t0) / n
+    # generous bound (CI noise): the real sites run against multi-ms
+    # dispatches, so < 5 us/call is unmeasurable (< 1% criterion)
+    assert per_call < 5e-6
+
+
+def test_enabled_registry_records_counts_and_timings():
+    reg = MetricsRegistry(enabled=True)
+    for i in range(3):
+        with reg.stage("fwd.column_pass", flops=1000, bytes_moved=10):
+            time.sleep(0.002)
+    with reg.stage("bwd.sampled_fold"):
+        pass
+    reg.count("fwd.subgrids", 7)
+    reg.count("fwd.subgrids", 3)
+    reg.gauge("fwd.plan", {"col_group": 2})
+    exp = reg.export()
+    assert exp["counters"]["fwd.subgrids"] == 10
+    assert exp["gauges"]["fwd.plan"] == {"col_group": 2}
+    st = exp["stages"]["fwd.column_pass"]
+    assert st["count"] == 3
+    assert st["flops"] == 3000 and st["bytes"] == 30
+    assert st["total_s"] >= 3 * 0.002
+    assert st["min_s"] <= st["mean_s"] <= st["max_s"]
+    assert st["min_s"] <= st["p99_s"] <= st["max_s"] + 1e-9
+    assert "tflops" in st
+    assert exp["total"]["flops"] == 3000
+    # the export is JSON-ready as promised
+    json.dumps(exp)
+
+
+def test_stage_mfu_against_operator_peak(monkeypatch):
+    monkeypatch.setenv("SWIFTLY_PEAK_TFLOPS", "2.0")
+    reg = MetricsRegistry(enabled=True)
+    with reg.stage("fwd.column_pass", flops=10**9):
+        time.sleep(0.001)
+    st = reg.export()["stages"]["fwd.column_pass"]
+    assert st["mfu_pct"] == pytest.approx(
+        100 * st["tflops"] / 2.0, rel=0.01
+    )
+
+
+def test_jsonl_round_trip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    reg = MetricsRegistry(enabled=True, jsonl_path=path)
+    with reg.stage("fwd.sampled_facet_pass", flops=5, bytes_moved=6):
+        pass
+    with reg.stage("bwd.finish"):
+        pass
+    reg.event("heartbeat", done=3, total=9)
+    reg.disable()
+    records = [json.loads(ln) for ln in path.read_text().splitlines()]
+    kinds = [r["kind"] for r in records]
+    assert kinds[0] == "open"
+    stage_events = [r for r in records if r["kind"] == "stage"]
+    assert [r["name"] for r in stage_events] == [
+        "fwd.sampled_facet_pass", "bwd.finish",
+    ]
+    assert stage_events[0]["flops"] == 5
+    assert stage_events[0]["bytes"] == 6
+    assert all("wall_s" in r for r in stage_events)
+    hb = [r for r in records if r["kind"] == "heartbeat"]
+    assert hb == [{"kind": "heartbeat", "done": 3, "total": 9}]
+    # disabled registry appends nothing further
+    reg.count("x")
+    with reg.stage("y"):
+        pass
+    assert len(path.read_text().splitlines()) == len(records)
+
+
+def test_reset_drops_state():
+    reg = MetricsRegistry(enabled=True)
+    reg.count("a")
+    with reg.stage("s"):
+        pass
+    reg.reset()
+    exp = reg.export()
+    assert exp["counters"] == {} and exp["stages"] == {}
+    assert exp["enabled"]  # reset wipes data, not enablement
+
+
+# ---------------------------------------------------------------------------
+# Manifest / artifact schema
+# ---------------------------------------------------------------------------
+
+
+def test_run_manifest_completeness():
+    m = run_manifest(
+        baseline_source="measured", params={"N": 1024, "mode": "streamed"}
+    )
+    for field in (
+        "schema", "timestamp_utc", "hostname", "python", "jax", "numpy",
+        "device", "git_sha", "git_dirty", "argv", "env",
+        "baseline_source", "config_params", "config_hash",
+    ):
+        assert field in m, field
+    assert m["baseline_source"] == "measured"
+    assert m["device"]["platform"] == "cpu"
+    assert m["device"]["count"] >= 1
+    # env capture holds only engine-relevant knobs
+    assert all(
+        k.startswith(("SWIFTLY_", "BENCH_", "JAX_", "XLA_")) for k in m["env"]
+    )
+    # config hash is deterministic and order-insensitive
+    m2 = run_manifest(params={"mode": "streamed", "N": 1024})
+    assert m2["config_hash"] == m["config_hash"]
+    json.dumps(m)
+
+
+def test_run_manifest_rejects_bad_baseline_source():
+    with pytest.raises(ValueError, match="baseline_source"):
+        run_manifest(baseline_source="guessed")
+
+
+def test_validate_artifact():
+    good = {
+        "metric": "x wall-clock", "value": 1.0, "unit": "s",
+        "baseline_source": "estimated",
+        "manifest": run_manifest(baseline_source="estimated"),
+    }
+    assert validate_artifact(good) == []
+    assert validate_artifact({"value": 1.0}) != []
+    missing = dict(good)
+    missing["manifest"] = {k: v for k, v in good["manifest"].items()
+                           if k != "git_sha"}
+    assert any("git_sha" in p for p in validate_artifact(missing))
+    bad_src = dict(good, baseline_source="vibes")
+    bad_src["manifest"] = dict(good["manifest"], baseline_source="vibes")
+    assert any("baseline_source" in p for p in validate_artifact(bad_src))
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat / partial artifacts
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_emits_to_event_log(tmp_path, global_registry):
+    global_registry.enable(tmp_path / "hb.jsonl")
+    hb = Heartbeat(total=100, label="subgrids", interval_s=0.0)
+    hb.update(25)
+    hb.update(25)
+    hb.finish()
+    global_registry.disable()
+    records = [
+        json.loads(ln)
+        for ln in (tmp_path / "hb.jsonl").read_text().splitlines()
+    ]
+    beats = [r for r in records if r["kind"] == "heartbeat"]
+    assert [b["done"] for b in beats] == [25, 50, 50]
+    assert beats[0]["total"] == 100
+    assert beats[0]["rate_per_s"] > 0
+    assert beats[0]["eta_s"] is not None
+
+
+def test_partial_artifact_writer(tmp_path):
+    path = tmp_path / "partial.jsonl"
+    w = PartialArtifactWriter(path)
+    w.append({"leg": "a", "status": "started"})
+    w.append({"leg": "a", "value": 1.5})
+    assert w.read_all() == [
+        {"leg": "a", "status": "started"}, {"leg": "a", "value": 1.5},
+    ]
+    # disabled writer: every method a no-op
+    off = PartialArtifactWriter(None)
+    off.append({"x": 1})
+    assert off.read_all() == []
+
+
+# ---------------------------------------------------------------------------
+# Engine stage-name contract (CPU streamed round trip)
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_round_trip_emits_expected_stages(tmp_path, global_registry):
+    """The streamed forward/backward on a tiny CPU config must emit the
+    documented stage vocabulary (docs/observability.md) — the contract
+    the Perfetto trace names and the bench telemetry share."""
+    import jax
+
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        check_facet,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+
+    global_registry.enable(tmp_path / "stages.jsonl")
+    params = {"W": 8.0, "fov": 1.0, "N": 256, "yB_size": 96,
+              "yN_size": 128, "xA_size": 56, "xM_size": 64}
+    config = SwiftlyConfig(
+        backend="planar", dtype=jax.numpy.float32, **params
+    )
+    sources = [(1.0, 3, -5)]
+    fcs = make_full_facet_cover(config)
+    sgs = make_full_subgrid_cover(config)
+    tasks = [(fc, make_facet(config.image_size, fc, sources)) for fc in fcs]
+
+    fwd = StreamedForward(config, tasks, residency="device")
+    bwd = StreamedBackward(config, fcs, residency="sampled", fold_group=2)
+    for per_col, group in fwd.stream_column_groups(sgs):
+        bwd.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    facets = np.asarray(bwd.finish_device())
+    errs = [
+        check_facet(
+            config.image_size, fc,
+            config.core.as_complex(facets[i]), sources,
+        )
+        for i, fc in enumerate(fcs)
+    ]
+    assert max(errs) < 5e-3  # instrumentation must not perturb numerics
+
+    exp = global_registry.export()
+    expected = {
+        "fwd.facet_upload", "fwd.sampled_facet_pass", "fwd.column_pass",
+        "bwd.column_pass", "bwd.sampled_fold", "bwd.finish",
+    }
+    assert expected <= set(exp["stages"]), sorted(exp["stages"])
+    assert exp["counters"]["fwd.subgrids"] == len(sgs)
+    assert exp["counters"]["bwd.subgrids_folded"] == len(sgs)
+    assert exp["gauges"]["fwd.plan"]["mode"] == "resident"
+    # paired flops attribution on the compute stages
+    for name in ("fwd.sampled_facet_pass", "fwd.column_pass",
+                 "bwd.column_pass", "bwd.sampled_fold"):
+        assert exp["stages"][name].get("flops", 0) > 0, name
+    # and the JSONL log carries the same vocabulary
+    names = {
+        r["name"]
+        for r in map(
+            json.loads,
+            (tmp_path / "stages.jsonl").read_text().splitlines(),
+        )
+        if r.get("kind") == "stage"
+    }
+    assert expected <= names
+
+
+def test_streamed_disabled_emits_nothing(global_registry):
+    """With metrics off the same round trip records no state at all."""
+    import jax
+
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.parallel import StreamedForward
+
+    assert not global_registry.enabled
+    params = {"W": 8.0, "fov": 1.0, "N": 256, "yB_size": 96,
+              "yN_size": 128, "xA_size": 56, "xM_size": 64}
+    config = SwiftlyConfig(
+        backend="planar", dtype=jax.numpy.float32, **params
+    )
+    sources = [(1.0, 3, -5)]
+    fcs = make_full_facet_cover(config)
+    sgs = make_full_subgrid_cover(config)
+    tasks = [(fc, make_facet(config.image_size, fc, sources)) for fc in fcs]
+    fwd = StreamedForward(config, tasks, residency="device")
+    fwd.all_subgrids(sgs)
+    exp = global_registry.export()
+    assert exp["stages"] == {} and exp["counters"] == {}
