@@ -324,6 +324,73 @@ def _oom_soft(run, fwd, extra, fold_group=None, retries=2):
             gc.collect()
 
 
+def _plan_backward_passes(
+    F_total, yB, per_facet_acc, per_facet_rows, fold_group, budget,
+    fwd_min=3.3e9, reserve=1.2e9, n_facet_env=0, n_row_env=0,
+):
+    """Facet x output-row-slab partition plan for the sampled backward.
+
+    Returns ``(parts, resident_bytes)``: `parts` is the pass list
+    [(i0, i1, r0, r1), ...] — facet subset [i0, i1) x accumulator rows
+    [r0, r1) — and `resident_bytes` the largest pass's accumulator +
+    row-pipeline residency (what the forward's auto-sizers must leave
+    free, `fwd.hbm_headroom`).
+
+    Partition order: facets first (the 64k mechanism — single-facet
+    passes leave the shared subgrid stream the most headroom), then
+    output-row slabs within a facet once even ONE facet's accumulator
+    exceeds the per-pass budget (the 128k mechanism: one 45056^2 facet
+    is 16.2 GiB; the fold's "ri" index restricts trivially, see
+    `StreamedBackward(row_slab=...)`). Every pass consumes the SAME
+    subgrid stream, so with the spill cache the total cost is one
+    forward + len(parts) cache-fed backward passes.
+
+    :param per_facet_acc: one facet's WHOLE [yB, yB] accumulator bytes
+    :param per_facet_rows: one facet's [m, yB] column-rows bytes (the
+        fold pipeline keeps 2*fold_group + 2 of these live per facet)
+    :param budget: per-device HBM bytes (None = unpartitioned, e.g. CPU)
+    :param n_facet_env / n_row_env: operator overrides
+        (BENCH_BWD_FACET_PASSES / BENCH_BWD_ROW_SLABS)
+    """
+    rows_resident = (2 * fold_group + 2) * per_facet_rows
+    usable = None if budget is None else budget - fwd_min - reserve
+    if n_facet_env:
+        n_parts = max(1, min(int(n_facet_env), F_total))
+    elif usable is None:
+        n_parts = 1
+    elif F_total * (per_facet_acc + rows_resident) <= usable:
+        n_parts = 1
+    else:
+        # once partitioning is forced, single-facet passes win: the
+        # stream feed dominates each pass and its sizing scales with
+        # the headroom the accumulator leaves (measured at 64k)
+        n_parts = F_total
+    F_sub = -(-F_total // n_parts)
+    n_row = 1
+    if n_row_env:
+        n_row = max(1, min(int(n_row_env), yB))
+    elif usable is not None and n_parts > 1:
+        per_pass = F_sub * (per_facet_acc + rows_resident)
+        if per_pass > usable:
+            # slab the accumulator; the column rows stay full-width
+            # (the fold consumes every row whatever slab it outputs)
+            acc_budget = usable - F_sub * rows_resident
+            per_row = max(1.0, F_sub * per_facet_acc / yB)
+            h = int(acc_budget // per_row) if acc_budget > 0 else 0
+            n_row = -(-yB // max(1, h))
+    row_h = -(-yB // n_row)
+    parts = [
+        (i0, min(i0 + F_sub, F_total), r0, min(r0 + row_h, yB))
+        for i0 in range(0, F_total, F_sub)
+        for r0 in range(0, yB, row_h)
+    ]
+    resident = max(
+        (i1 - i0) * (per_facet_acc * (r1 - r0) / yB + rows_resident)
+        for i0, i1, r0, r1 in parts
+    )
+    return parts, int(resident)
+
+
 def _numpy_baseline_from_parts(params, sources, reps=3):
     """Extrapolate the numpy forward wall-clock from sampled sub-ops.
 
@@ -396,6 +463,23 @@ def _numpy_baseline_from_parts(params, sources, reps=3):
         len(sgs),
     )
     return prep_lo + col_lo + sg_lo, prep_hi + col_hi + sg_hi
+
+
+# Coarse on-chip wall-clock guesses per size class, seconds — the
+# projected-cost skip in main() only needs the ORDER OF MAGNITUDE
+# (r4/r5 measured: 4k legs ~1-3 s + baseline, 32k streamed ~18 s,
+# 32k round trip ~38 s, 64k round trip ~650 s + compiles). Roundtrips
+# roughly double the leg; compiles/baselines are folded into the guess.
+_LEG_COST_GUESS_S = {
+    "1k": 30, "2k": 40, "4k": 60, "8k": 90, "16k": 120,
+    "32k": 240, "64k": 900, "128k": 700,
+}
+
+
+def _leg_cost_guess_s(name, mode):
+    """Projected wall for one leg (config size class x mode)."""
+    base = _LEG_COST_GUESS_S.get(name.split("[")[0], 300)
+    return base * (2 if "roundtrip" in mode else 1)
 
 
 def _cover_kwargs(facet_configs, subgrid_configs):
@@ -632,21 +716,17 @@ def run_one(config_name, mode):
         per_facet_acc = yB * yB * per_el
         per_facet_rows = core.xM_yN_size * yB * per_el
 
-        def _per_facet_resident():
-            # accumulator + live column rows (fold_group pending + 2 in
-            # flight, bounded by the backward's rows checksum pipeline)
-            return per_facet_acc + (
-                2 * fold_group[0] + 2
-            ) * per_facet_rows
-
-        # Facet-partitioned backward: the 64k+ accumulator (34 GiB at
-        # 64k) cannot fit 16 GiB HBM whole, but the backward column pass
-        # AND the adjoint fold both scale with the facets in the
-        # program, so P passes over facet subsets do the SAME total
-        # backward work — only the forward (which must replay every
-        # subgrid column for each pass) repeats. Pass sizing: largest
-        # per-pass facet count whose accumulator + row pipeline leaves
-        # the forward its minimum streaming working set.
+        # Facet x row-slab partitioned backward: the 64k+ accumulator
+        # (34 GiB at 64k) cannot fit 16 GiB HBM whole, and ONE 128k
+        # facet's accumulator (16.2 GiB) is itself past HBM — but the
+        # backward column pass and the adjoint fold both scale with the
+        # facets (and the fold's output rows) in the program, so P
+        # passes over facet subsets x row slabs do the SAME total
+        # backward work. The subgrid stream every pass consumes is
+        # persisted ONCE by the spill cache (utils.spill), so the
+        # forward runs once and passes 2..P are cache-fed — before the
+        # cache, each pass replayed the full forward (~8 x 73 s of the
+        # 64k round trip's 703 s).
         from swiftly_tpu.utils.profiling import probe_hbm_bytes
 
         env_hbm = os.environ.get("SWIFTLY_HBM_BUDGET")
@@ -658,49 +738,29 @@ def run_one(config_name, mode):
         fwd_min = 3.3e9  # measured: the 32k roundtrip fwd plan (G=3,
         # slab_depth=2) streams green inside this
         reserve = 1.2e9  # fold row-blocks + donation-copy slack
-        n_env = int(os.environ.get("BENCH_BWD_FACET_PASSES", "0"))
-        if n_env:
-            n_parts = min(n_env, F_total)
-        elif budget is None:
-            n_parts = 1
-        else:
-            usable = budget - fwd_min - reserve
-            if F_total * _per_facet_resident() <= usable:
-                n_parts = 1
-            else:
-                # once partitioning is forced, single-facet passes win:
-                # the forward replay dominates each pass and its column
-                # group scales with the headroom the accumulator leaves
-                # (measured at 64k: 9 passes at G=4 take 655 s; 5
-                # two-facet passes at G=2 extrapolate to ~3000 s)
-                n_parts = F_total
-        # equal-size parts minimise distinct jit shapes (one extra
-        # compile per distinct per-pass facet count)
-        F_sub = -(-F_total // n_parts)
-        parts = [
-            (i, min(i + F_sub, F_total))
-            for i in range(0, F_total, F_sub)
-        ]
 
-        def _set_headroom():
-            # no mesh in the bench, so each part's _FacetStack has
-            # n_total == n_real and the raw part size IS the allocated
-            # accumulator's facet count (a meshed caller would need the
-            # padded count here)
-            fwd.hbm_headroom = int(
-                max(i1 - i0 for i0, i1 in parts) * _per_facet_resident()
-                + reserve
+        def _make_plan():
+            # re-planned per run: _oom_soft may have shrunk fold_group
+            return _plan_backward_passes(
+                F_total, yB, per_facet_acc, per_facet_rows,
+                fold_group[0], budget, fwd_min=fwd_min, reserve=reserve,
+                n_facet_env=int(
+                    os.environ.get("BENCH_BWD_FACET_PASSES", "0")
+                ),
+                n_row_env=int(
+                    os.environ.get("BENCH_BWD_ROW_SLABS", "0")
+                ),
             )
 
-        _set_headroom()
-
-        def _verify_part(facets_dev, i0, i1):
-            """Device-side RMS of reproduced facets [i0:i1) vs the round
-            trip's own inputs; returns per-facet mean |res|^2."""
+        def _verify_part(facets_dev, i0, i1, r0, r1):
+            """Device-side RMS of reproduced facet (row-slab) [i0:i1) x
+            [r0:r1) vs the round trip's own inputs; returns per-facet
+            mean |res|^2 over the slab."""
             n = i1 - i0
+            Rs = r1 - r0
             if fwd._dev_facets is not None and fwd._facets_real:
                 ref = fwd._dev_facets[0]
-                res_re = facets_dev[:n, :, :, 0] - ref[i0:i1]
+                res_re = facets_dev[:n, :, :, 0] - ref[i0:i1, r0:r1]
                 res_im = facets_dev[:n, :, :, 1]
                 return jnp.mean(
                     res_re * res_re + res_im * res_im, axis=(1, 2)
@@ -713,16 +773,19 @@ def run_one(config_name, mode):
                 # verification step. Out-of-chunk pixels drop out of the
                 # scatter (mode="drop"); each chunk's scalar is pulled
                 # before the next dispatch (async dispatch would put all
-                # chunks' transients live at once).
-                yB = facets_dev.shape[1]
-                n_ch = max(1, int(yB * yB * 12 / 1.2e9))
-                while yB % n_ch:
+                # chunks' transients live at once). Row slabs reuse the
+                # same program with slab-shifted pixel rows (off-slab
+                # rows land outside [0, Rs) and drop).
+                yB_full = facets_dev.shape[2]
+                n_ch = max(1, int(Rs * yB_full * 12 / 1.2e9))
+                while Rs % n_ch:
                     n_ch += 1
-                Cr = yB // n_ch
-                chunk_rms2 = _chunk_rms2_fn(Cr, yB)
+                Cr = Rs // n_ch
+                chunk_rms2 = _chunk_rms2_fn(Cr, yB_full)
                 rms2s = []
                 for i in range(i0, i1):
                     _, r, c, v = fwd._sparse_pixels(i, i + 1)
+                    r = (r - r0).astype(np.int32)  # slab-relative rows
                     total = 0.0
                     for ci in range(n_ch):
                         total += float(
@@ -733,13 +796,13 @@ def run_one(config_name, mode):
                                 )
                             )
                         )
-                    rms2s.append(total / (yB * yB))
+                    rms2s.append(total / (Rs * yB_full))
                 return jnp.asarray(rms2s)
             # re-upload per-facet references (grouped forward or
             # complex facets: no resident copy to compare against)
             rms2s = []
             for i in range(i0, i1):
-                ref = jnp.asarray(
+                host_ref = (
                     fwd._facet_data[i]
                     if not fwd._facets_real
                     else np.stack(
@@ -748,6 +811,7 @@ def run_one(config_name, mode):
                         axis=-1,
                     )
                 )
+                ref = jnp.asarray(host_ref[r0:r1])
                 rms2s.append(
                     _rms2_device(config.core, facets_dev[i - i0], ref)
                 )
@@ -759,10 +823,35 @@ def run_one(config_name, mode):
             adjoint-einsum accumulator, the finished facets are compared
             on device with the round trip's own input facets, and one
             scalar pull forces completion of the whole graph. When the
-            full-facet accumulator exceeds HBM, the backward runs in
-            facet-subset passes (same total backward work; the forward
-            replays per pass)."""
-            _set_headroom()
+            accumulator exceeds HBM the backward runs in facet-subset x
+            row-slab passes (same total backward work); the subgrid
+            stream is persisted ONCE by the spill cache, so the whole
+            partitioned round trip costs 1 forward + len(parts)
+            cache-fed backward passes (counter-asserted via
+            `fwd.passes`). A stream too large for the cache budget
+            falls back to forward replay per pass — exact, just the
+            pre-cache cost model."""
+            from swiftly_tpu.utils.spill import SpillCache
+
+            parts, resident = _make_plan()
+            fwd.hbm_headroom = int(resident + reserve)
+            n_facet_passes = len({(p[0], p[1]) for p in parts})
+            n_row_slabs = len({(p[2], p[3]) for p in parts})
+            extra["bwd_plan"] = {
+                "n_passes": len(parts),
+                "n_facet_passes": n_facet_passes,
+                "n_row_slabs": n_row_slabs,
+            }
+            use_spill = (
+                len(parts) > 1
+                and os.environ.get("BENCH_SPILL", "1") != "0"
+            )
+            spill = SpillCache() if use_spill else None
+            passes0 = 0
+            if metrics.enabled():
+                passes0 = (metrics.export().get("counters") or {}).get(
+                    "fwd.passes", 0
+                )
             max_rms2 = 0.0
             extra["pass_s"] = []
             hb = Heartbeat(
@@ -771,32 +860,41 @@ def run_one(config_name, mode):
                 interval_s=float(os.environ.get("BENCH_HEARTBEAT_S", "30")),
                 log=log,
             )
-            for kpart, (i0, i1) in enumerate(parts):
+            for kpart, (i0, i1, r0, r1) in enumerate(parts):
                 t_pass = time.time()
                 bwd = StreamedBackward(
                     config, list(facet_configs[i0:i1]),
                     residency="sampled", fold_group=fold_group[0],
+                    row_slab=(r0, r1) if (r0, r1) != (0, yB) else None,
                 )
                 # group feeding: one vmapped column pass + one fold per
                 # forward column group (per-column feeding pays the
-                # per-dispatch tunnel latency 2G+ times per group)
+                # per-dispatch tunnel latency 2G+ times per group);
+                # pass 1 records the stream, later passes are cache-fed
                 for per_col, group in fwd.stream_column_groups(
-                    subgrid_configs
+                    subgrid_configs, spill=spill
                 ):
                     bwd.add_subgrid_group(
                         [[sg for _, sg in col] for col in per_col], group
                     )
                     hb.update(sum(len(col) for col in per_col))
                 facets_dev = bwd.finish_device()
-                rms2 = _verify_part(facets_dev, i0, i1)
+                rms2 = _verify_part(facets_dev, i0, i1, r0, r1)
                 max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
                 del facets_dev, bwd
                 extra["pass_s"].append(round(time.time() - t_pass, 1))
                 if len(parts) > 1:
                     log.info(
-                        "roundtrip pass %d/%d (facets %d:%d) done",
-                        kpart + 1, len(parts), i0, i1,
+                        "roundtrip pass %d/%d (facets %d:%d rows %d:%d)"
+                        " done",
+                        kpart + 1, len(parts), i0, i1, r0, r1,
                     )
+            if spill is not None:
+                extra["spill"] = spill.stats()
+            if metrics.enabled():
+                extra["forward_passes"] = (
+                    metrics.export().get("counters") or {}
+                ).get("fwd.passes", 0) - passes0
             return max_rms2 ** 0.5
 
         t0 = time.time()
@@ -879,16 +977,10 @@ def run_one(config_name, mode):
         baseline_source = "estimated"
     else:
         baseline_source = "measured"
-    if baseline_estimated and env_baseline:
-        # operator-supplied (e.g. from a prior run of the same config):
-        # the 64k-scale sampled sub-ops alone take minutes of host time
-        numpy_total = float(env_baseline)
-        if partial_scale:
-            # the supplied figure covers the full cover; the measured
-            # run only 1/partial_scale of its columns
-            numpy_total /= partial_scale
-    elif baseline_estimated:
-        numpy_total, numpy_hi = _numpy_baseline_from_parts(params, sources)
+    def _estimator_scale():
+        """The mode/cover rescale the parts estimator needs to compare
+        like with like (shared by the estimated path and the operator-
+        supplied provenance check)."""
         scale = 1.0
         if sparse_fov:
             # the parts estimator times the DENSE facet cover; every
@@ -912,6 +1004,52 @@ def run_one(config_name, mode):
             kw = _cover_kwargs(facet_configs, subgrid_configs)
             core = config.core
             scale *= 1.0 + _bb(core, **kw) / _fb(core, **kw)
+        return scale
+
+    if baseline_estimated and env_baseline:
+        # operator-supplied (e.g. from a prior run of the same config).
+        # Provenance is ENFORCED at record time: the estimator bracket
+        # is measured anyway (minutes of host time at 64k — the price
+        # of an auditable artifact) and recorded NEXT TO the operator
+        # figure; a >1.5x disagreement with the bracket warns loudly
+        # and stamps `baseline_disagreement` (round-5 flagship
+        # artifacts carried hand-typed 600.0/7000.0 baselines ~3.6x off
+        # the same round's rehearsal — structurally silent until here).
+        numpy_total = float(env_baseline)
+        if partial_scale:
+            # the supplied figure covers the full cover; the measured
+            # run only 1/partial_scale of its columns
+            numpy_total /= partial_scale
+        try:
+            est_lo, est_hi = _numpy_baseline_from_parts(params, sources)
+        except Exception:
+            log.warning(
+                "estimator bracket failed; operator baseline recorded "
+                "UNCHECKED", exc_info=True,
+            )
+        else:
+            scale = _estimator_scale()
+            est_lo *= scale
+            est_hi *= scale
+            extra["numpy_baseline_bracket_s"] = [
+                round(est_lo, 2), round(est_hi, 2)
+            ]
+            if numpy_total < est_lo / 1.5 or numpy_total > est_hi * 1.5:
+                factor = max(
+                    est_lo / max(numpy_total, 1e-9),
+                    numpy_total / max(est_hi, 1e-9),
+                )
+                extra["baseline_disagreement"] = round(factor, 2)
+                log.warning(
+                    "operator-supplied numpy baseline %.1f s disagrees "
+                    "%.1fx with the measured estimator bracket "
+                    "[%.1f, %.1f] s — recording both; vs_baseline uses "
+                    "the OPERATOR figure, audit it against the bracket",
+                    numpy_total, factor, est_lo, est_hi,
+                )
+    elif baseline_estimated:
+        numpy_total, numpy_hi = _numpy_baseline_from_parts(params, sources)
+        scale = _estimator_scale()
         numpy_total *= scale
         numpy_hi *= scale
         # vs_baseline uses the LOW end (min-of-reps): under-, never
@@ -1036,6 +1174,10 @@ def smoke():
     # (recorded in the manifest's env capture; a real run sets a
     # measured value or runs on a device with a published peak)
     os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+    # force a 2-pass facet-partitioned backward so the spill-cache path
+    # (fill + cache-fed pass) and its artifact fields are exercised on
+    # CPU — the single-pass plan would never touch the cache
+    os.environ.setdefault("BENCH_BWD_FACET_PASSES", "2")
     metrics.enable(jsonl_path)
     name = os.environ.get("BENCH_SMOKE_CONFIG", "1k[1]-n512-256")
     record = run_one(name, "roundtrip-streamed")
@@ -1055,6 +1197,30 @@ def smoke():
                 problems.append(f"stage {s} missing {field}")
     if not (telemetry.get("total") or {}).get("mfu_pct"):
         problems.append("telemetry total missing mfu_pct")
+    # spill-cache schema: the 2-pass backward must have filled the cache
+    # on pass 1 and fed pass 2 from it — exactly ONE forward pass
+    # (the tentpole's cost model, counter-asserted), spill stats in the
+    # artifact, and prefetch hits recorded
+    spill_block = record.get("spill") or {}
+    if not spill_block:
+        problems.append("roundtrip-streamed artifact missing spill stats")
+    else:
+        for field in ("entries", "complete", "ram_bytes", "writes"):
+            if field not in spill_block:
+                problems.append(f"spill stats missing {field}")
+        if not spill_block.get("complete"):
+            problems.append(f"spill cache incomplete: {spill_block}")
+    counters = telemetry.get("counters") or {}
+    if record.get("forward_passes") != 1:
+        problems.append(
+            "cache-fed round trip must execute exactly 1 forward pass, "
+            f"got forward_passes={record.get('forward_passes')} "
+            f"(fwd.passes counter={counters.get('fwd.passes')})"
+        )
+    if not counters.get("spill.prefetch_hits"):
+        problems.append(
+            f"no spill prefetch hits in counters {sorted(counters)}"
+        )
     import json as _json
 
     with open(jsonl_path) as fh:
@@ -1116,15 +1282,19 @@ def main():
     if legacy:
         entries = [(legacy, os.environ.get("BENCH_MODE", "batched"))]
     else:
+        # Default legs sized for the 870 s driver window (BENCH_r05 ran
+        # the old 8-leg list incl. two 64k legs and died at rc=124 with
+        # nothing on stdout): smoke-scale 1k round trip, the 4k fused
+        # legs, 32k streamed + sparse, and the 32k round trip as the
+        # headline. The 64k/128k flagship legs run via an explicit
+        # BENCH_CONFIGS with a matching BENCH_TIME_BUDGET_S.
         spec = os.environ.get(
             "BENCH_CONFIGS",
+            "1k[1]-n512-256:roundtrip-streamed,"
             "4k[1]-n2k-512:batched,4k[1]-n2k-512:roundtrip,"
             "32k[1]-n16k-512:streamed,"
-            "32k[1]-n16k-512:roundtrip-streamed,"
             "32k[1]-n16k-512:streamed-sparse,"
-            "128k[1]-n32k-512:streamed-partial,"
-            "64k[1]-n32k-512:roundtrip-streamed,"
-            "64k[1]-n32k-512:streamed",
+            "32k[1]-n16k-512:roundtrip-streamed",
         )
         entries = []
         for item in spec.split(","):
@@ -1136,7 +1306,7 @@ def main():
     # window (BENCH_r03 died with the headline unmeasured), and its line
     # is re-printed at the end so the headline is the last stdout line.
     t_start = time.time()
-    budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "5400"))
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET_S", "870"))
     state = {"headline_line": None}
 
     def _on_term(signum, frame):  # pragma: no cover - signal path
@@ -1154,10 +1324,22 @@ def main():
         name, mode = entries[pos]
         is_headline = pos == len(entries) - 1
         elapsed = time.time() - t_start
-        if budget_s and not is_headline and elapsed > 0.75 * budget_s:
+        # Two skip rules for non-headline legs: the old high-water mark
+        # (elapsed > 0.75 * budget), and a PROJECTED overrun — starting
+        # a leg whose size-class cost guess does not fit the remaining
+        # window is how BENCH_r05 overran 870 s with legs already in
+        # hand. A guess can only skip, never kill: headline runs first
+        # and unconditionally.
+        skip_reason = None
+        if budget_s and not is_headline:
+            if elapsed > 0.75 * budget_s:
+                skip_reason = "time budget"
+            elif elapsed + _leg_cost_guess_s(name, mode) > 0.95 * budget_s:
+                skip_reason = "time budget (projected leg cost)"
+        if skip_reason:
             skip_record = {
                 "metric": f"{name} ({mode})",
-                "skipped": "time budget",
+                "skipped": skip_reason,
                 "elapsed_s": round(elapsed, 1),
             }
             print(json.dumps(skip_record), flush=True)

@@ -1186,6 +1186,14 @@ def _bwd_sampled_fold_fn(core):
     accumulator. The final (clamped) block re-covers rows the previous
     block already folded; `keep` zeroes those contributions, making the
     tiling exact for any yB.
+
+    ``row0`` (traced int32) is the ROW-SLAB offset: the accumulator may
+    cover only output rows [row0, row0 + acc.shape[1]) of the facet —
+    the "ri" einsum index restricts trivially, so a facet whose full
+    [yB, yB] accumulator exceeds HBM (one 128k facet: 16.2 GiB) splits
+    into HBM-sized row slabs, each an independent backward pass over
+    the same subgrid stream. Whole-facet callers pass row0 = 0; the
+    full facet width is read off the rows' pass-through j axis.
     """
     import jax.numpy as jnp
 
@@ -1197,8 +1205,9 @@ def _bwd_sampled_fold_fn(core):
 
     if _planar(core):
 
-        def fn(acc, rows, e0, krows):
-            F, yB = acc.shape[0], acc.shape[1]
+        def fn(acc, rows, e0, krows, row0):
+            F, Rs = acc.shape[0], acc.shape[1]
+            yB = rows.shape[2]  # full facet width (pass-through j axis)
             dt = acc.dtype
             fb = core._p.extract_mid(core._Fb, yB, 0)  # [yB] real, no 1/yN
             # conjugate per-facet phase: rows * w^{-e0_f kt_r}
@@ -1216,22 +1225,26 @@ def _bwd_sampled_fold_fn(core):
             f = lambda a, b: jnp.einsum(
                 "ri,frj->fij", a, b, precision=prec
             )
-            B = _fold_row_block(F, yB, np.dtype(dt).itemsize)
-            n_blk = -(-yB // B)
+            B = min(_fold_row_block(F, yB, np.dtype(dt).itemsize), Rs)
+            n_blk = -(-Rs // B)
             fbj = jnp.asarray(fb, dt)
 
             def body(carry, xs):
                 i0, start = xs
-                i = start + jnp.arange(B, dtype=jnp.int32)
-                keep = (i >= i0).astype(dt)
+                ii = start + jnp.arange(B, dtype=jnp.int32)  # slab-rel
+                keep = (ii >= i0).astype(dt)
+                i_abs = row0 + ii  # absolute row: phases + Fb weight
                 b_cos, b_sin = phases(
-                    _mulmod(krows[:, None], i[None, :], yN)
+                    _mulmod(krows[:, None], i_abs[None, :], yN)
                 )
                 Bc = b_cos.astype(dt)
                 Bs = b_sin.astype(dt)
                 out_re = f(Bc, Rr2) + f(Bs, Ri2)
                 out_im = f(Bc, Ri2) - f(Bs, Rr2)
-                w = jax.lax.dynamic_slice_in_dim(fbj, start, B) * keep
+                w = (
+                    jax.lax.dynamic_slice_in_dim(fbj, row0 + start, B)
+                    * keep
+                )
                 out = jnp.stack([out_re, out_im], axis=-1)
                 out = out * w[None, :, None, None]
                 z = jnp.int32(0)
@@ -1246,35 +1259,41 @@ def _bwd_sampled_fold_fn(core):
                 )
 
             i0s = jnp.arange(n_blk, dtype=jnp.int32) * B
-            starts = jnp.minimum(i0s, yB - B)
+            starts = jnp.minimum(i0s, Rs - B)
             acc, _ = jax.lax.scan(body, acc, (i0s, starts))
             return acc
 
     else:
 
-        def fn(acc, rows, e0, krows):
-            F, yB = acc.shape[0], acc.shape[1]
+        def fn(acc, rows, e0, krows, row0):
+            F, Rs = acc.shape[0], acc.shape[1]
+            yB = rows.shape[2]  # full facet width (pass-through j axis)
             fb = core._p.extract_mid(core._Fb, yB, 0)
             p_cos, p_sin = phases(
                 _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
             )
             phi = (p_cos - 1j * p_sin).astype(core.dtype)  # [F, R]
             rows2 = rows * phi[..., None]
-            B = _fold_row_block(F, yB, np.dtype(core.dtype).itemsize)
-            n_blk = -(-yB // B)
+            B = min(
+                _fold_row_block(F, yB, np.dtype(core.dtype).itemsize), Rs
+            )
+            n_blk = -(-Rs // B)
             fbj = jnp.asarray(fb)
 
             def body(carry, xs):
                 i0, start = xs
-                i = start + jnp.arange(B, dtype=jnp.int32)
-                keep = i >= i0
+                ii = start + jnp.arange(B, dtype=jnp.int32)  # slab-rel
+                keep = ii >= i0
+                i_abs = row0 + ii  # absolute row: phases + Fb weight
                 b_cos, b_sin = phases(
-                    _mulmod(krows[:, None], i[None, :], yN)
+                    _mulmod(krows[:, None], i_abs[None, :], yN)
                 )
                 Bm = (b_cos - 1j * b_sin).astype(core.dtype)  # [R, B]
                 out = jnp.einsum("ri,frj->fij", Bm, rows2)
                 w = jnp.where(
-                    keep, jax.lax.dynamic_slice_in_dim(fbj, start, B), 0
+                    keep,
+                    jax.lax.dynamic_slice_in_dim(fbj, row0 + start, B),
+                    0,
                 )
                 out = out * w[None, :, None].astype(core.dtype)
                 z = jnp.int32(0)
@@ -1289,7 +1308,7 @@ def _bwd_sampled_fold_fn(core):
                 )
 
             i0s = jnp.arange(n_blk, dtype=jnp.int32) * B
-            starts = jnp.minimum(i0s, yB - B)
+            starts = jnp.minimum(i0s, Rs - B)
             acc, _ = jax.lax.scan(body, acc, (i0s, starts))
             return acc
 
@@ -1329,7 +1348,9 @@ def _bwd_sampled_fold_sharded(core, mesh):
     return _shmap(
         _scoped("swiftly/bwd.sampled_fold", _bwd_sampled_fold_fn(core)),
         mesh,
-        in_specs=(_P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P()),
+        in_specs=(
+            _P(FACET_AXIS), _P(FACET_AXIS), _P(FACET_AXIS), _P(), _P(),
+        ),
         out_specs=_P(FACET_AXIS),
         donate=(0,),
     )
@@ -1901,7 +1922,12 @@ def _column_group_finish_fn(core, subgrid_size, colpass):
 
 @functools.lru_cache(maxsize=None)
 def _column_group_finish_j(core, subgrid_size, colpass):
-    return _jit(donate=(0,))(
+    # the accumulator is NOT donated: the finish crops xM -> xA, so no
+    # output ever matches the donated buffer's shape and XLA ignored the
+    # donation with a "Some donated buffers were not usable:
+    # f32[...,xM,xM,2]" warning per compile (BENCH_r05 tail). The buffer
+    # frees at the caller's `del acc` exactly as before.
+    return _jit()(
         _scoped(
             "swiftly/fwd.group_finish",
             _column_group_finish_fn(core, subgrid_size, colpass),
@@ -2108,6 +2134,10 @@ class StreamedForward:
         # (e.g. an uploaded oracle-sample stack); subtracted from the HBM
         # budget the auto-sizers see
         self.hbm_headroom = 0
+        # extra per-group output stacks the auto-sizers must price: the
+        # spill-cache fill keeps ONE extra finished [G, S, xA, xA] stack
+        # live (the previous group, until its d2h copy lands)
+        self.spill_out_stacks = 0
 
     # -- sparse synthesis --------------------------------------------------
 
@@ -2240,7 +2270,7 @@ class StreamedForward:
             groups, size, whole_groups=whole_groups
         )
 
-    def stream_column_groups(self, subgrid_configs):
+    def stream_column_groups(self, subgrid_configs, spill=None):
         """Yield (per_col_items, group_subgrids) per COLUMN GROUP of the
         sampled-DFT paths: `per_col_items` is a list (one entry per
         column) of [(input_index, SubgridConfig), ...] and
@@ -2249,6 +2279,18 @@ class StreamedForward:
         dispatch (e.g. `StreamedBackward.add_subgrid_group`) — slicing
         per column and re-dispatching per column pays the tunnel's
         per-dispatch latency G+ times over.
+
+        With ``spill`` (a `utils.spill.SpillCache`) the stream is
+        PERSISTED: the first call runs ONE forward pass, copying each
+        group's finished stack d2h one group behind the compute (the
+        copy overlaps the next group's dispatch chain), and every later
+        call with a complete cache yields the SAME stream from host RAM
+        (or disk) with the next group's h2d upload prefetched ahead of
+        the consumer — no forward replay. A facet- or row-slab-
+        partitioned backward (P consume passes) thus costs 1 forward +
+        P cache feeds instead of P forwards + P backwards. If the
+        stream exceeds the cache budget the fill gives up and every
+        call replays the forward (exact, just the old cost model).
         """
         subgrid_configs = list(subgrid_configs)
         groups = _group_full_columns(subgrid_configs)
@@ -2258,7 +2300,84 @@ class StreamedForward:
                 "stream_column_groups is a sampled-path (residency="
                 "'device') API"
             )
-        yield from self._sampled_generator(groups, size, whole_groups=True)
+        spill_tag = (
+            len(subgrid_configs), size,
+            (subgrid_configs[0].off0, subgrid_configs[0].off1),
+            (subgrid_configs[-1].off0, subgrid_configs[-1].off1),
+        )
+        if spill is not None and spill.complete:
+            if spill.tag != spill_tag:
+                raise ValueError(
+                    f"spill cache holds a different subgrid stream "
+                    f"(tag {spill.tag} != {spill_tag}); reset() it or "
+                    "pass the cover it was recorded for"
+                )
+            if _metrics.enabled():
+                _metrics.count("spill.replay_feeds")
+            yield from self._replay_spilled_groups(spill)
+            return
+        if spill is not None and spill.gave_up:
+            # a previous fill overflowed the budget: re-recording would
+            # overflow again — replay the forward without the d2h cost
+            if _metrics.enabled():
+                _metrics.count("spill.fallback_replays")
+            spill = None
+        if _metrics.enabled():
+            _metrics.count("fwd.passes")
+        gen = self._sampled_generator(groups, size, whole_groups=True)
+        if spill is None:
+            yield from gen
+            return
+        self.spill_out_stacks = 1  # the sizers price the held-back stack
+        try:
+            spill.begin_fill(tag=spill_tag)
+            prev = None
+            for per_col, out_g in gen:
+                # store group k-1 while group k's dispatch chain runs:
+                # the d2h pull waits only on k-1's compute, so transfer
+                # and compute overlap at depth 1
+                if prev is not None:
+                    self._spill_store(spill, *prev)
+                prev = (per_col, out_g)
+                yield per_col, out_g
+            if prev is not None:
+                self._spill_store(spill, *prev)
+            spill.end_fill()
+        finally:
+            self.spill_out_stacks = 0
+
+    def _spill_store(self, spill, per_col, out_g):
+        """Copy one yielded group's stack to the cache (d2h + put)."""
+        if spill.gave_up:
+            return  # an earlier eviction voided the fill: skip the d2h
+        with _metrics.stage("spill.write") as st:
+            host = np.asarray(out_g)
+            st.bytes_moved = int(host.nbytes)
+        if spill.put(per_col, host) and _metrics.enabled():
+            _metrics.count("spill.writes")
+            _metrics.count("spill.bytes_written", int(host.nbytes))
+
+    def _replay_spilled_groups(self, spill):
+        """Yield the cached stream with double-buffered h2d prefetch:
+        group k+1's upload is DISPATCHED before group k is yielded, so
+        the wire runs under the consumer's compute on group k."""
+        import jax.numpy as jnp
+
+        pending = None
+        for k in range(len(spill)):
+            with _metrics.stage("spill.read") as st:
+                host = spill.get(k)
+                st.bytes_moved = int(host.nbytes)
+            with _metrics.stage("spill.h2d") as st:
+                dev = jnp.asarray(host)
+                st.bytes_moved = int(host.nbytes)
+            if _metrics.enabled():
+                _metrics.count("spill.prefetch_hits")
+            if pending is not None:
+                yield pending
+            pending = (spill.meta(k), dev)
+        if pending is not None:
+            yield pending
 
     def stream_columns(self, subgrid_configs, device_arrays=False):
         """Yield (col_items, subgrids) per column; one device program each.
@@ -2272,6 +2391,8 @@ class StreamedForward:
         subgrid_configs = list(subgrid_configs)
         groups = _group_full_columns(subgrid_configs)
         size = subgrid_configs[0].size
+        if _metrics.enabled():
+            _metrics.count("fwd.passes")
         if self._base.residency == "device":
             gen = self._sampled_generator(groups, size)
         else:
@@ -2592,6 +2713,7 @@ class StreamedForward:
                                 base, budget, len(col_offs0), S,
                                 subgrid_size, self._facets_real, Fg, c,
                                 slab_depth=depth, warn=False,
+                                extra_out_stacks=self.spill_out_stacks,
                             ),
                         )
                     ),
@@ -2606,6 +2728,7 @@ class StreamedForward:
             grouped_col_group_for_budget(
                 base, budget, len(col_offs0), S, subgrid_size,
                 self._facets_real, Fg, chunk, slab_depth=depth,
+                extra_out_stacks=self.spill_out_stacks,
             )
         n_chunks = G // chunk
         colpass = _resolve_colpass(core, Fg)
@@ -2798,10 +2921,11 @@ class StreamedForward:
                         s0 // Fg + 1, n_slabs,
                         time.time() - t_start, _rss_gib(),
                     )
-            # finish the whole group in one program (acc donated: the
-            # finished array replaces it; the runtime orders the finish
-            # after the pending slab steps on the same buffer — the
-            # depth-2 checksum pipeline keeps bounding live slabs)
+            # finish the whole group in one program (acc freed by the
+            # `del` below — donation can't alias it into the cropped
+            # output; the runtime orders the finish after the pending
+            # slab steps on the same buffer, and the depth-2 checksum
+            # pipeline keeps bounding live slabs)
             with _metrics.stage("fwd.group_finish"):
                 finished = finfn(acc, so_c, m0_c, m1_c)
             del acc
@@ -2866,7 +2990,8 @@ class StreamedForward:
         if budget is None:
             return n_cols
         return col_group_for_budget(
-            self._base, budget, n_cols, real=self._facets_real
+            self._base, budget, n_cols, real=self._facets_real,
+            extra_out_stacks=self.spill_out_stacks,
         )
 
     def all_subgrids(self, subgrid_configs):
@@ -2895,7 +3020,7 @@ def facet_stack_bytes(base, real=False):
 
 def grouped_col_group_for_budget(
     base, budget, n_cols, S, subgrid_size, real, facet_group, chunk,
-    slab_depth=2, warn=True,
+    slab_depth=2, warn=True, extra_out_stacks=0,
 ):
     """Largest column-group G for the facet-slab-streamed sampled path.
 
@@ -2906,7 +3031,12 @@ def grouped_col_group_for_budget(
     per-chunk scan transients ([chunk, S, xM, xM] carry + prep1 rows),
     and a trig/fragmentation reserve. ``warn=False`` evaluates quietly —
     the executor's (G, chunk) sweep probes chunks it may not select and
-    re-warns only for the chosen pair.
+    re-warns only for the chosen pair. ``extra_out_stacks`` prices
+    additional caller-held [S, xA, xA]-per-unit-G output stacks: the
+    spill-cache fill holds the previous group's finished stack until
+    its d2h copy lands (`StreamedForward.spill_out_stacks`), and a
+    consumer pinning group stacks for other reasons can account for
+    them the same way.
 
     CALIBRATION BASIS (r5): the consumer-transient term was relaxed from
     3x to 2x [S, xA, xA] against measured 128k boundaries on a 16 GiB
@@ -2953,7 +3083,8 @@ def grouped_col_group_for_budget(
     # the 3x model allowed only G=2, and the OOM boundary sits at G=6
     # with two groups in flight.)
     per_G = (
-        4 * facet_group * m * yB + S * xM * xM + 2 * S * xA * xA
+        4 * facet_group * m * yB + S * xM * xM
+        + (2 + extra_out_stacks) * S * xA * xA
     ) * dsize
     reserve = 0.6e9
     headroom = budget - slab_b - chunk_b - reserve
@@ -2976,7 +3107,8 @@ def grouped_col_group_for_budget(
     return max(1, min(G, ((n_cols + chunk - 1) // chunk) * chunk))
 
 
-def col_group_for_budget(base, budget, n_cols, real=False):
+def col_group_for_budget(base, budget, n_cols, real=False,
+                         extra_out_stacks=0):
     """Largest sampled-DFT column-group G whose working set fits `budget`
     bytes on one device (facet stack + per-G transients).
 
@@ -3018,12 +3150,14 @@ def col_group_for_budget(base, budget, n_cols, real=False):
             + Sb * F * xM * m
             + S * xM * xM
         ) * dsize
-        col_b = (3 * F * m * yB + 2 * S * xA * xA) * dsize
+        col_b = (
+            3 * F * m * yB + (2 + extra_out_stacks) * S * xA * xA
+        ) * dsize
         headroom = budget - facets_b - reserve - flat_col
     else:
         col_b = (
             2 * F * m * yB + F * m * core.yN_size
-            + S * xM * xM + 2 * S * xA * xA
+            + S * xM * xM + (2 + extra_out_stacks) * S * xA * xA
         ) * dsize
         headroom = budget - facets_b - reserve
     if headroom <= col_b:
@@ -3060,10 +3194,18 @@ class StreamedBackward:
         nor the d2h budget of a tunnel-attached chip.
     :param fold_group: ("sampled") columns folded per einsum dispatch —
         batches the adjoint contraction depth to fold_group*m rows.
+    :param row_slab: ("sampled") optional (r0, r1) OUTPUT-ROW SLAB: the
+        image-space accumulator covers only facet rows [r0, r1) — the
+        adjoint fold's "ri" index restricts trivially, so a facet whose
+        whole accumulator exceeds HBM (one 128k facet: 16.2 GiB) splits
+        into row slabs, each an independent pass over the same subgrid
+        stream (pair with the spill cache so the forward runs once).
+        `finish()` then emits [F, r1 - r0, yB] slabs; slabs concatenated
+        along axis 1 equal the whole-facet backward (pinned by tests).
     """
 
     def __init__(self, swiftly_config, facet_configs, col_block=512,
-                 residency="host", fold_group=4):
+                 residency="host", fold_group=4, row_slab=None):
         self._base = _StreamedBase(
             swiftly_config, facet_configs, col_block, residency
         )
@@ -3073,6 +3215,22 @@ class StreamedBackward:
         self._acc = None  # ("sampled") device [F, yB, yB(,2)] accumulator
         self._fold_group = max(1, int(fold_group))
         self._fold_mode = resolve_fold_mode()  # sampled | ct | fft
+        self._row_slab = None
+        if row_slab is not None:
+            r0, r1 = int(row_slab[0]), int(row_slab[1])
+            yB = self._base.stack.size
+            if residency != "sampled":
+                raise ValueError("row_slab requires residency='sampled'")
+            if self._fold_mode != "sampled":
+                raise ValueError(
+                    "row_slab requires the sampled fold body "
+                    f"(SWIFTLY_FOLD=sampled|auto, got {self._fold_mode!r})"
+                )
+            if not (0 <= r0 < r1 <= yB):
+                raise ValueError(
+                    f"row_slab {(r0, r1)} outside the facet rows [0, {yB})"
+                )
+            self._row_slab = (r0, r1)
         self._pending_rows = []  # ("sampled") [(off0, rows [F, m, yB(,2)])]
         # ("sampled") depth-2 fold-completion pipeline: dispatch is
         # asynchronous and block_until_ready is not completion on tunnel
@@ -3207,8 +3365,9 @@ class StreamedBackward:
 
         base = self._base
         if self._acc is None:
+            r0, r1 = self._row_slab or (0, base.stack.size)
             shape = (
-                base.stack.n_total, base.stack.size, base.stack.size
+                base.stack.n_total, r1 - r0, base.stack.size
             ) + _tail(base.core)
             if base.mesh is not None:
                 self._acc = base._place(
@@ -3276,8 +3435,13 @@ class StreamedBackward:
                 fold_flops = bwd_fold_flops(
                     core, base.stack.n_real, yB, int(rows_cat.shape[1])
                 )
+                if self._row_slab is not None:
+                    # fold FLOPs scale with the output rows computed
+                    r0, r1 = self._row_slab
+                    fold_flops = int(fold_flops * (r1 - r0) / yB)
+            row0 = jnp.int32((self._row_slab or (0, 0))[0])
             with _metrics.stage("bwd.sampled_fold", flops=fold_flops):
-                self._acc = foldfn(self._acc, rows_cat, e0, krows)
+                self._acc = foldfn(self._acc, rows_cat, e0, krows, row0)
         # the checksum slice depends on the whole fold having executed
         self._fold_inflight.append(jnp.sum(self._acc[:, 0]))
 
@@ -3434,9 +3598,15 @@ class StreamedBackward:
         if self._acc is None:
             raise RuntimeError("No subgrids were added")
         fn = _sampled_finish_j(self.core)
+        masks0 = self._base._masks0_dev
+        if self._row_slab is not None:
+            # the finish mask is over the output-row axis: slice it to
+            # the slab (the j axis and everything else stay full-width)
+            r0, r1 = self._row_slab
+            masks0 = masks0[:, r0:r1]
         acc, self._acc = self._acc, None  # donated to the finish program
         with _metrics.stage("bwd.finish"):
-            out = fn(acc, self._base._masks0_dev)
+            out = fn(acc, masks0)
         self._finished = True
         return out
 

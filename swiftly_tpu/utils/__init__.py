@@ -1,5 +1,5 @@
 """Utilities: profiling, memory accounting, FLOP models, checkpointing,
-compilation cache."""
+compilation cache, subgrid-stream spill cache."""
 
 from .cache import enable_compilation_cache
 from .checkpoint import (
@@ -20,6 +20,7 @@ from .flops import (
     peak_tflops,
     sampled_facet_pass_flops,
 )
+from .spill import SpillCache, spill_budget_bytes
 from .profiling import (
     MemorySampler,
     collective_bytes_backward,
@@ -50,5 +51,7 @@ __all__ = [
     "save_backward_state",
     "save_streamed_backward_state",
     "sampled_facet_pass_flops",
+    "SpillCache",
+    "spill_budget_bytes",
     "trace",
 ]

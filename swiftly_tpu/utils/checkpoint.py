@@ -146,6 +146,8 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
         # fold first so the snapshot is self-contained)
         backward._flush_folds()
         meta["has_acc"] = backward._acc is not None
+        slab = getattr(backward, "_row_slab", None)
+        meta["row_slab"] = list(slab) if slab else None
         if backward._acc is not None:
             arrays["acc"] = np.asarray(backward._acc)
     for key, rows in backward._naf.items():
@@ -177,6 +179,18 @@ def restore_streamed_backward_state(path, backward):
                 f"accumulator and NAF rows are not interchangeable)"
             )
         if is_sampled:
+            saved_slab = meta.get("row_slab")
+            have_slab = getattr(backward, "_row_slab", None)
+            if (saved_slab or None) != (
+                list(have_slab) if have_slab else None
+            ):
+                # a slab accumulator restored at a different row window
+                # would fold garbage silently — refuse
+                raise ValueError(
+                    f"Checkpoint holds row_slab={saved_slab} state; this "
+                    f"session uses row_slab="
+                    f"{list(have_slab) if have_slab else None}"
+                )
             if meta.get("has_acc"):
                 backward._acc = backward._base._place(data["acc"])
             return [tuple(p) for p in meta["processed"]]

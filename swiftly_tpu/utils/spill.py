@@ -1,0 +1,239 @@
+"""Subgrid-stream spill cache: persist a streamed forward's output once,
+feed every backward consume pass from the cache.
+
+A facet-partitioned sampled backward (bench.py's ``roundtrip-streamed``
+at 64k+) runs P passes over facet subsets, and each pass needs the SAME
+subgrid stream — before this cache the forward replayed P times (at 64k:
+8 × ~73 s of replay in a 703 s round trip, the headline defect of the
+round-5 ledger). The cache is the offload-and-overlap discipline of
+"Large-Scale Discrete Fourier Transform on TPUs" (arXiv:2002.03260)
+applied to the stream: during the single forward pass each column
+group's finished subgrid stack is copied device→host one group behind
+the compute (the d2h overlaps the next group's dispatch chain), and
+during each backward consume pass the stacks are uploaded host→device
+one group AHEAD of the consumer (double-buffered prefetch), so the MXU
+never waits on the wire.
+
+Storage is a host-RAM ring with optional disk backing:
+
+* entries up to ``SWIFTLY_SPILL_BUDGET_GB`` (default: half of
+  ``MemAvailable``) stay in RAM;
+* past the budget, entries spill to ``SWIFTLY_SPILL_DIR`` as ``.npy``
+  memmaps, written in bounded chunks (no multi-GiB dirty-page bursts);
+* with no disk dir, over-budget entries are EVICTED: the fill is marked
+  incomplete (``gave_up``) and consumers fall back to replaying the
+  forward — a capacity miss degrades to the old cost model, never to a
+  wrong answer.
+
+The cache stores plain float arrays; a d2h→h2d round trip of those is
+bit-exact, so a cache-fed backward is bit-identical to a replay-fed one
+(pinned by tests/test_spill.py). Instrumentation: ``spill.write`` /
+``spill.read`` / ``spill.h2d`` stage timers (bytes_moved attributed) and
+``spill.writes`` / ``spill.evictions`` / ``spill.prefetch_hits`` /
+``spill.disk_reads`` / ``spill.fallback_replays`` counters, recorded by
+the streamed executor against ``obs.metrics``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+
+__all__ = ["SpillCache", "spill_budget_bytes"]
+
+logger = logging.getLogger(__name__)
+
+# chunk size for disk-backed writes: bounds the per-write dirty-page
+# burst while keeping the stream sequential (memmap-friendly)
+_DISK_CHUNK_BYTES = 256e6
+
+
+def spill_budget_bytes():
+    """Host-RAM byte budget for spilled stream entries.
+
+    ``SWIFTLY_SPILL_BUDGET_GB`` when set; else half of the kernel's
+    ``MemAvailable`` at call time (the stream shares the host with the
+    facet data and staging buffers); else a conservative 8 GiB.
+    """
+    env = os.environ.get("SWIFTLY_SPILL_BUDGET_GB")
+    if env:
+        return float(env) * 2**30
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024 / 2
+    except Exception:  # pragma: no cover - non-linux
+        pass
+    return 8 * 2**30  # pragma: no cover - /proc always present on CI
+
+
+class SpillCache:
+    """Ordered store of one forward pass's column-group subgrid stacks.
+
+    Lifecycle: ``begin_fill()`` → ``put(meta, array)`` per group →
+    ``end_fill()``; then ``complete`` is True iff every put landed (RAM
+    or disk). Consumers iterate ``range(len(cache))`` with ``meta(k)`` /
+    ``get(k)``. ``reset()`` returns to empty (deleting disk files).
+
+    :param budget_bytes: host-RAM budget (default `spill_budget_bytes`)
+    :param spill_dir: directory for over-budget entries; default
+        ``SWIFTLY_SPILL_DIR``; None disables disk backing (over-budget
+        entries are evicted and the fill gives up)
+    """
+
+    def __init__(self, budget_bytes=None, spill_dir=None):
+        self.budget_bytes = (
+            spill_budget_bytes() if budget_bytes is None else float(budget_bytes)
+        )
+        if spill_dir is None:
+            spill_dir = os.environ.get("SWIFTLY_SPILL_DIR") or None
+        self.spill_dir = spill_dir
+        self._own_dir = None  # created lazily under spill_dir
+        self._entries = []  # ("ram", ndarray) | ("disk", path)
+        self._meta = []
+        self.ram_bytes = 0
+        self.disk_bytes = 0
+        self.complete = False
+        self.gave_up = False
+        self.tag = None  # stream identity (set by begin_fill)
+        self.counters = {
+            "writes": 0,
+            "evictions": 0,
+            "ram_reads": 0,
+            "disk_reads": 0,
+            "fills": 0,
+        }
+
+    # -- fill ---------------------------------------------------------------
+
+    def begin_fill(self, tag=None):
+        """Start (re)recording a stream; drops any previous entries.
+        ``tag`` identifies the stream (e.g. the cover's shape) so a
+        consumer can refuse a cache recorded for different inputs."""
+        self._clear_entries()
+        self.complete = False
+        self.gave_up = False
+        self.tag = tag
+        self.counters["fills"] += 1
+
+    def put(self, meta, array) -> bool:
+        """Append one group's host array (+ its per-column metadata).
+
+        Returns False when the entry was evicted (over budget, no disk
+        backing) — the fill is then marked ``gave_up`` and ``end_fill``
+        will leave the cache incomplete.
+        """
+        array = np.asarray(array)
+        self.counters["writes"] += 1
+        if self.ram_bytes + array.nbytes <= self.budget_bytes:
+            self._entries.append(("ram", array))
+            self.ram_bytes += array.nbytes
+        elif self.spill_dir is not None:
+            path = self._disk_write(len(self._entries), array)
+            self._entries.append(("disk", path))
+            self.disk_bytes += array.nbytes
+        else:
+            self.counters["evictions"] += 1
+            self.gave_up = True
+            _metrics.count("spill.evictions")
+            return False
+        self._meta.append(meta)
+        return True
+
+    def end_fill(self):
+        """Seal the fill: the cache is complete iff nothing was evicted
+        and at least one entry landed."""
+        self.complete = bool(self._entries) and not self.gave_up
+        if self.gave_up:
+            logger.warning(
+                "spill cache gave up: stream exceeds the %.1f GiB RAM "
+                "budget and no SWIFTLY_SPILL_DIR is set — backward "
+                "passes will fall back to forward replay",
+                self.budget_bytes / 2**30,
+            )
+        return self.complete
+
+    # -- consume ------------------------------------------------------------
+
+    def __len__(self):
+        return len(self._meta)
+
+    def meta(self, k):
+        return self._meta[k]
+
+    def get(self, k):
+        """Entry k as a host ndarray (RAM hit or a full disk read)."""
+        kind, payload = self._entries[k]
+        if kind == "ram":
+            self.counters["ram_reads"] += 1
+            return payload
+        self.counters["disk_reads"] += 1
+        _metrics.count("spill.disk_reads")
+        with _metrics.stage("spill.disk_read") as st:
+            arr = np.load(payload)
+            st.bytes_moved = int(arr.nbytes)
+        return arr
+
+    # -- maintenance --------------------------------------------------------
+
+    def reset(self):
+        """Back to empty (disk files deleted); counters are kept."""
+        self._clear_entries()
+        self.complete = False
+        self.gave_up = False
+
+    def stats(self):
+        """JSON-ready summary for bench artifacts."""
+        return {
+            "entries": len(self._entries),
+            "complete": self.complete,
+            "ram_bytes": int(self.ram_bytes),
+            "disk_bytes": int(self.disk_bytes),
+            "budget_bytes": int(self.budget_bytes),
+            "disk_backed": self.spill_dir is not None,
+            **self.counters,
+        }
+
+    def _clear_entries(self):
+        self._entries = []
+        self._meta = []
+        self.ram_bytes = 0
+        self.disk_bytes = 0
+        if self._own_dir is not None:
+            shutil.rmtree(self._own_dir, ignore_errors=True)
+            self._own_dir = None
+
+    def _disk_write(self, k, array):
+        """Chunked memmap write of one entry under the spill dir."""
+        if self._own_dir is None:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            self._own_dir = tempfile.mkdtemp(
+                prefix="swiftly_spill_", dir=self.spill_dir
+            )
+        path = os.path.join(self._own_dir, f"group_{k:05d}.npy")
+        with _metrics.stage("spill.disk_write") as st:
+            mm = np.lib.format.open_memmap(
+                path, mode="w+", dtype=array.dtype, shape=array.shape
+            )
+            row_bytes = max(1, array[:1].nbytes) if array.ndim else 1
+            step = max(1, int(_DISK_CHUNK_BYTES // row_bytes))
+            for s in range(0, array.shape[0], step):
+                mm[s : s + step] = array[s : s + step]
+            mm.flush()
+            del mm
+            st.bytes_moved = int(array.nbytes)
+        return path
+
+    def __del__(self):  # pragma: no cover - interpreter-shutdown path
+        try:
+            if self._own_dir is not None:
+                shutil.rmtree(self._own_dir, ignore_errors=True)
+        except Exception:
+            pass

@@ -200,6 +200,110 @@ def test_scaled_offset_guard_rejects_unsafe_sizes():
         scaled_offset(1, 1 << 23, 1 << 23)
 
 
+def test_128k_roundtrip_row_slab_plan_constructible():
+    """A 128k round-trip plan with row-slab partitioning is
+    constructible on a 16 GiB-class budget: the planner must split the
+    9-facet backward into single-facet passes x >= 2 row slabs (one
+    45056^2 accumulator is 16.2 GiB, itself past HBM), with every
+    pass's residency inside the budget it was given — and with the
+    spill cache the whole plan costs ONE forward."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from bench import _plan_backward_passes
+
+    N, yB, yN, xM = 131072, 45056, 65536, 512
+    m = xM * yN // N  # 256
+    F_total = (-(-N // yB)) ** 2  # 3x3 facet cover
+    per_el = 8  # planar f32 (re, im)
+    per_facet_acc = yB * yB * per_el  # 16.2 GiB
+    per_facet_rows = m * yB * per_el
+    budget, fwd_min, reserve = 16.0e9, 3.3e9, 1.2e9
+    parts, resident = _plan_backward_passes(
+        F_total, yB, per_facet_acc, per_facet_rows, 2, budget,
+        fwd_min=fwd_min, reserve=reserve,
+    )
+    n_facet_passes = len({(p[0], p[1]) for p in parts})
+    n_row_slabs = len({(p[2], p[3]) for p in parts})
+    assert n_facet_passes == F_total  # single-facet passes
+    assert n_row_slabs >= 2  # the row-slab axis engaged
+    assert resident + fwd_min + reserve <= budget
+    # the passes tile the full (facet, row) grid exactly, in order
+    seen_rows = sorted({(p[2], p[3]) for p in parts})
+    assert seen_rows[0][0] == 0 and seen_rows[-1][1] == yB
+    for (a0, a1), (b0, b1) in zip(seen_rows, seen_rows[1:]):
+        assert a1 == b0
+    # an unpartitioned budget (CPU) stays one whole pass
+    assert _plan_backward_passes(
+        F_total, yB, per_facet_acc, per_facet_rows, 2, None
+    )[0] == [(0, F_total, 0, yB)]
+
+
+def test_128k_proxy_row_slab_roundtrip_dryrun():
+    """Dryrun validation of the row-slab round trip AT 128k GEOMETRY
+    (N=131072, the full boundary yN=65536) on the CPU proxy: a partial
+    2x2 cover streams through the real 128k programs, the backward runs
+    as row-slab passes fed from one spill-cached forward, and the
+    reproduced slabs agree with the whole-facet backward on the same
+    stream. Oracle numerics for the forward leg are pinned by
+    `test_128k_proxy_streamed_forward_vs_oracle` above."""
+    from swiftly_tpu import SwiftlyConfig
+    from swiftly_tpu.models.config import FacetConfig, SubgridConfig
+    from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+    from swiftly_tpu.ops.oracle import make_facet_from_sources
+    from swiftly_tpu.utils.spill import SpillCache
+
+    params = dict(
+        W=13.5625, fov=1.0, N=131072, yB_size=1024, yN_size=65536,
+        xA_size=448, xM_size=512,
+    )
+    config = SwiftlyConfig(backend="jax", **params)
+    sources = [(1.0, 3, -5)]
+    facet_configs = [FacetConfig(0, 0, 1024), FacetConfig(0, 768, 1024)]
+    facet_tasks = [
+        (
+            fc,
+            make_facet_from_sources(
+                sources, config.image_size, fc.size, [fc.off0, fc.off1]
+            ),
+        )
+        for fc in facet_configs
+    ]
+    subgrid_configs = [
+        SubgridConfig(o0, o1, 448) for o0 in (0, 448) for o1 in (0, 448)
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    spill = SpillCache(budget_bytes=1e9)
+    yB = 1024
+
+    def feed(bwd):
+        for per_col, group in fwd.stream_column_groups(
+            subgrid_configs, spill=spill
+        ):
+            bwd.add_subgrid_group(
+                [[sg for _, sg in col] for col in per_col], group
+            )
+        return bwd.finish()
+
+    slabs = [
+        feed(
+            StreamedBackward(
+                config, facet_configs, residency="sampled",
+                row_slab=(r0, r1),
+            )
+        )
+        for r0, r1 in [(0, 600), (600, yB)]
+    ]
+    whole = feed(
+        StreamedBackward(config, facet_configs, residency="sampled")
+    )
+    np.testing.assert_allclose(
+        np.concatenate(slabs, axis=1), whole, atol=1e-12
+    )
+    assert spill.complete  # one forward fed all three backward passes
+
+
 def test_bench_sparse_sources_inside_fov_cover():
     """Every spread bench source, rescaled for the sparse-FoV mode, must
     lie inside the circle of covered facet CENTRES for the catalogue's

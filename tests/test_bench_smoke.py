@@ -61,6 +61,20 @@ def test_bench_smoke_leg(tmp_path):
         assert {"count", "total_s", "mean_s", "p99_s"} <= set(entry)
     assert telemetry["total"]["mfu_pct"] > 0
 
+    # spill-cache cost model: the smoke's 2-pass facet-partitioned
+    # backward must run exactly ONE forward (pass 2 cache-fed), with
+    # the spill stats stamped into the artifact and the spill stages
+    # visible in the telemetry
+    assert record["forward_passes"] == 1
+    spill = record["spill"]
+    assert spill["complete"] and spill["entries"] >= 1
+    assert spill["writes"] >= 1 and spill["evictions"] == 0
+    counters = telemetry["counters"]
+    assert counters["fwd.passes"] == 1
+    assert counters["spill.prefetch_hits"] >= 1
+    assert {"spill.write", "spill.read", "spill.h2d"} <= set(stages)
+    assert record["bwd_plan"]["n_passes"] == 2
+
     names = {
         r["name"]
         for r in map(json.loads, jsonl.read_text().splitlines())

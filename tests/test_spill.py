@@ -1,0 +1,206 @@
+"""Subgrid-stream spill cache tests.
+
+The cache must be exact (a cache-fed backward is BIT-IDENTICAL to a
+replay-fed one: d2h -> host RAM/disk -> h2d of float arrays changes no
+bits), must kill the backward leg's forward replays (one `fwd.passes`
+counter tick however many consume passes run), and must degrade to
+replay — never to a wrong answer — when the stream exceeds its budget.
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import SwiftlyConfig, make_facet, make_full_facet_cover, \
+    make_full_subgrid_cover
+from swiftly_tpu.obs import metrics
+from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+from swiftly_tpu.utils.spill import SpillCache
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+SOURCES = [(1, 1, 0), (0.5, -30, 40)]
+
+
+def _setup(backend):
+    config = SwiftlyConfig(backend=backend, **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_configs, subgrid_configs, facet_tasks
+
+
+# ---------------------------------------------------------------------------
+# Cache unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def test_spill_cache_ram_roundtrip_bitexact():
+    cache = SpillCache(budget_bytes=1e9)
+    rng = np.random.default_rng(0)
+    arrays = [rng.standard_normal((2, 3, 4)).astype(np.float32)
+              for _ in range(3)]
+    cache.begin_fill()
+    for k, a in enumerate(arrays):
+        assert cache.put({"k": k}, a)
+    assert cache.end_fill()
+    assert cache.complete and len(cache) == 3
+    for k, a in enumerate(arrays):
+        np.testing.assert_array_equal(cache.get(k), a)
+        assert cache.meta(k) == {"k": k}
+    stats = cache.stats()
+    assert stats["entries"] == 3 and stats["writes"] == 3
+    assert stats["ram_bytes"] == sum(a.nbytes for a in arrays)
+    assert stats["evictions"] == 0 and stats["disk_bytes"] == 0
+
+
+def test_spill_cache_disk_backing_bitexact(tmp_path):
+    """Entries past the RAM budget land on disk and read back exactly;
+    the cache stays complete."""
+    rng = np.random.default_rng(1)
+    arrays = [rng.standard_normal((5, 7)).astype(np.float32)
+              for _ in range(4)]
+    # budget fits the first two entries only
+    cache = SpillCache(
+        budget_bytes=2 * arrays[0].nbytes, spill_dir=str(tmp_path)
+    )
+    cache.begin_fill()
+    for k, a in enumerate(arrays):
+        assert cache.put(k, a)
+    assert cache.end_fill()
+    stats = cache.stats()
+    assert stats["complete"]
+    assert stats["ram_bytes"] == 2 * arrays[0].nbytes
+    assert stats["disk_bytes"] == 2 * arrays[0].nbytes
+    for k, a in enumerate(arrays):
+        np.testing.assert_array_equal(cache.get(k), a)
+    assert cache.stats()["disk_reads"] == 2
+    cache.reset()  # deletes the disk files
+    import os
+
+    assert not any(
+        f.startswith("group_") for d in os.listdir(tmp_path)
+        for f in (os.listdir(tmp_path / d) if (tmp_path / d).is_dir()
+                  else [d])
+    )
+
+
+def test_spill_cache_eviction_gives_up():
+    """Over budget with no disk dir: the entry is evicted, the fill ends
+    incomplete, and `gave_up` tells consumers to replay."""
+    cache = SpillCache(budget_bytes=8, spill_dir=None)
+    cache.begin_fill()
+    assert not cache.put(0, np.zeros(64, np.float32))
+    assert not cache.end_fill()
+    assert cache.gave_up and not cache.complete
+    assert cache.stats()["evictions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Cache-fed streaming
+# ---------------------------------------------------------------------------
+
+
+def _run_partitioned_backward(config, facet_configs, subgrid_configs,
+                              facet_tasks, spill, n_parts=2):
+    """One forward object, n_parts sampled-backward passes over facet
+    subsets, each fed via stream_column_groups(spill=...)."""
+    fwd = StreamedForward(config, facet_tasks, residency="device",
+                          col_group=4)
+    F_sub = -(-len(facet_configs) // n_parts)
+    outs = []
+    for i0 in range(0, len(facet_configs), F_sub):
+        bwd = StreamedBackward(
+            config, list(facet_configs[i0 : i0 + F_sub]),
+            residency="sampled",
+        )
+        for per_col, group in fwd.stream_column_groups(
+            subgrid_configs, spill=spill
+        ):
+            bwd.add_subgrid_group(
+                [[sg for _, sg in col] for col in per_col], group
+            )
+        outs.append(bwd.finish())
+    return np.concatenate(outs)
+
+
+@pytest.mark.parametrize("backend", ["jax", "planar"])
+def test_cache_fed_backward_bitidentical_to_replay(backend):
+    """The tentpole equivalence pin: a facet-partitioned backward fed
+    from the spill cache (1 forward + P cache feeds) is BIT-IDENTICAL
+    per facet to the replay-fed one (P forwards), and the forward-pass
+    counter proves the cost model changed shape."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup(backend)
+
+    ref = _run_partitioned_backward(
+        config, facet_configs, subgrid_configs, facet_tasks, spill=None
+    )
+
+    metrics.reset()
+    metrics.enable()
+    try:
+        out = _run_partitioned_backward(
+            config, facet_configs, subgrid_configs, facet_tasks,
+            spill=SpillCache(budget_bytes=1e9),
+        )
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    np.testing.assert_array_equal(out, ref)
+    assert counters["fwd.passes"] == 1  # the replays are gone
+    assert counters["spill.replay_feeds"] == 1
+    assert counters["spill.prefetch_hits"] >= 1
+    assert counters["spill.writes"] >= 1
+    assert counters.get("spill.fallback_replays", 0) == 0
+
+
+def test_cache_disk_backed_feed_matches(tmp_path):
+    """A cache whose budget forces every entry to disk feeds the same
+    stream (exercises the chunked memmap write + full read path)."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    ref = _run_partitioned_backward(
+        config, facet_configs, subgrid_configs, facet_tasks, spill=None
+    )
+    out = _run_partitioned_backward(
+        config, facet_configs, subgrid_configs, facet_tasks,
+        spill=SpillCache(budget_bytes=1, spill_dir=str(tmp_path)),
+    )
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_spill_eviction_falls_back_to_replay():
+    """Stream exceeds the budget, no disk: the fill gives up and every
+    pass replays the forward — results identical, counters honest."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    ref = _run_partitioned_backward(
+        config, facet_configs, subgrid_configs, facet_tasks, spill=None
+    )
+    metrics.reset()
+    metrics.enable()
+    try:
+        cache = SpillCache(budget_bytes=1, spill_dir=None)
+        out = _run_partitioned_backward(
+            config, facet_configs, subgrid_configs, facet_tasks,
+            spill=cache,
+        )
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    np.testing.assert_array_equal(out, ref)
+    assert cache.gave_up and not cache.complete
+    assert counters["fwd.passes"] == 2  # both passes replayed
+    assert counters["spill.fallback_replays"] == 1  # pass 2 skipped fill
+    assert counters["spill.evictions"] >= 1
+    assert "spill.replay_feeds" not in counters

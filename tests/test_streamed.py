@@ -427,6 +427,94 @@ def test_facet_partitioned_sampled_backward_matches_full():
     np.testing.assert_allclose(np.concatenate(parts), full, atol=1e-12)
 
 
+def test_row_slab_backward_matches_whole_facet():
+    """The output-row-slab partition axis (the 128k mechanism): sampled
+    backwards over row slabs [0, h) and [h, yB), concatenated along the
+    row axis, equal the whole-facet backward — including a slab height
+    that does not divide the fold's row-block tiling."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+
+    full_b = StreamedBackward(config, facet_configs, residency="sampled")
+    full_b.add_subgrids(tasks)
+    full = full_b.finish()
+
+    yB = facet_configs[0].size
+    slabs = []
+    for r0, r1 in [(0, 150), (150, yB)]:
+        slab_b = StreamedBackward(
+            config, facet_configs, residency="sampled", row_slab=(r0, r1)
+        )
+        slab_b.add_subgrids(tasks)
+        out = slab_b.finish()
+        assert out.shape[1] == r1 - r0
+        slabs.append(out)
+    np.testing.assert_allclose(
+        np.concatenate(slabs, axis=1), full, atol=1e-12
+    )
+
+
+def test_row_slab_composes_with_facet_partition():
+    """Facet subsets x row slabs (the full 128k partition grid) tile the
+    whole-facet backward exactly."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    subgrids = fwd.all_subgrids(subgrid_configs)
+    tasks = [(sg, subgrids[i]) for i, sg in enumerate(subgrid_configs)]
+
+    full_b = StreamedBackward(config, facet_configs, residency="sampled")
+    full_b.add_subgrids(tasks)
+    full = full_b.finish()
+
+    yB = facet_configs[0].size
+    h = -(-yB // 2)
+    facet_parts = []
+    for i0 in range(0, len(facet_configs), 2):
+        row_parts = []
+        for r0 in range(0, yB, h):
+            b = StreamedBackward(
+                config, facet_configs[i0 : i0 + 2], residency="sampled",
+                row_slab=(r0, min(r0 + h, yB)),
+            )
+            b.add_subgrids(tasks)
+            row_parts.append(b.finish())
+        facet_parts.append(np.concatenate(row_parts, axis=1))
+    np.testing.assert_allclose(
+        np.concatenate(facet_parts), full, atol=1e-12
+    )
+
+
+def test_row_slab_validation():
+    config, facet_configs, _, _ = _setup("planar")
+    yB = facet_configs[0].size
+    with pytest.raises(ValueError, match="residency"):
+        StreamedBackward(
+            config, facet_configs, residency="device", row_slab=(0, 10)
+        )
+    with pytest.raises(ValueError, match="rows"):
+        StreamedBackward(
+            config, facet_configs, residency="sampled",
+            row_slab=(10, yB + 1),
+        )
+    with pytest.raises(ValueError, match="sampled fold"):
+        import os
+
+        prior = os.environ.get("SWIFTLY_FOLD")
+        os.environ["SWIFTLY_FOLD"] = "ct"
+        try:
+            StreamedBackward(
+                config, facet_configs, residency="sampled",
+                row_slab=(0, 10),
+            )
+        finally:
+            if prior is None:
+                del os.environ["SWIFTLY_FOLD"]
+            else:
+                os.environ["SWIFTLY_FOLD"] = prior
+
+
 def test_streamed_rejects_empty_facets():
     config = SwiftlyConfig(backend="planar", **TEST_PARAMS)
     with pytest.raises(ValueError, match="non-empty"):
