@@ -1149,6 +1149,304 @@ def run_one(config_name, mode):
     return result
 
 
+def _zipf_workload(subgrid_configs, n_requests, seed, zipf_s=1.1):
+    """A synthetic serving trace: requests zipf-distributed over
+    subgrid COLUMNS (a shuffled popularity ranking, p ∝ 1/rank^s),
+    uniform within a column — the ragged-demand shape the coalescing
+    scheduler exists for (a few hot columns coalesce into dense
+    batches; the tail arrives as singletons).
+
+    :return: (requested configs list, the hottest column's off0)
+    """
+    rng = np.random.default_rng(seed)
+    cols = sorted({sg.off0 for sg in subgrid_configs})
+    by_col = {}
+    for sg in subgrid_configs:
+        by_col.setdefault(sg.off0, []).append(sg)
+    order = rng.permutation(len(cols))
+    ranks = np.empty(len(cols), dtype=int)
+    ranks[order] = np.arange(len(cols))
+    p = 1.0 / (ranks + 1.0) ** zipf_s
+    p /= p.sum()
+    picks = rng.choice(len(cols), size=n_requests, p=p)
+    reqs = []
+    for c in picks:
+        col = by_col[cols[c]]
+        reqs.append(col[rng.integers(len(col))])
+    return reqs, cols[int(np.argmax(p))]
+
+
+def serve_bench(smoke_mode=False):
+    """`bench.py --serve [--smoke]`: the on-demand serving leg.
+
+    Replays a zipf-over-columns workload through
+    `swiftly_tpu.serve.SubgridService` (bounded admission queue →
+    locality-aware coalescing scheduler → stacked column programs) and
+    stamps the latency-SLO block into a BENCH-style artifact:
+    p50/p99 latency, throughput, shed rate, coalesce-hit rate, retry/
+    quarantine counts — the harness every future PR regresses serving
+    tail latency against.
+
+    The leg is also the serving fault drill: one burst overflows the
+    admission queue (sheds recorded, clients get structured rejects),
+    a cache feed seeded from the hottest column serves hits until a
+    FORCED EVICTION makes later lookups fall back to recomputation, a
+    fault injector fails one coalesced batch (its requests retry singly
+    to success), and one POISONED request (malformed mask) is
+    quarantined without wedging the column behind it. Every served
+    result is verified BIT-IDENTICAL against per-request
+    `get_subgrid_task` on a fresh forward.
+
+    With ``--smoke`` the leg validates the artifact schema
+    (`obs.validate_serve_artifact`) plus the drill outcomes and exits
+    nonzero on any problem — wired into tier-1 via
+    tests/test_bench_smoke.py.
+    """
+    import jax
+
+    from swiftly_tpu import api as _api
+    from swiftly_tpu.obs import metrics, run_manifest, validate_serve_artifact
+    from swiftly_tpu.models import SWIFT_CONFIGS
+    from swiftly_tpu.serve import (
+        AdmissionQueue,
+        CoalescingScheduler,
+        SubgridService,
+    )
+    from swiftly_tpu.models.config import SubgridConfig
+    from swiftly_tpu.parallel.streamed import CachedColumnFeed
+    from swiftly_tpu.utils import enable_compilation_cache
+    from swiftly_tpu.utils.spill import SpillCache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    out_path = os.environ.get("BENCH_SERVE_OUT", "BENCH_serve.json")
+    if smoke_mode:
+        os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+        metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get("BENCH_SERVE_CONFIG", "1k[1]-n512-256")
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "276"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "1234"))
+    zipf_s = float(os.environ.get("BENCH_SERVE_ZIPF_S", "1.1"))
+    max_depth = int(os.environ.get("BENCH_SERVE_DEPTH", "64"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "32"))
+    slo_ms = float(os.environ.get("BENCH_SERVE_SLO_MS", "30000"))
+
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    dtype = jax.numpy.float32
+    platform = jax.devices()[0].platform
+    config, fwd, facet_configs, subgrid_configs, sources = _build(
+        "planar", params, dtype
+    )
+    workload, hot_off0 = _zipf_workload(
+        subgrid_configs, n_requests, seed, zipf_s
+    )
+
+    # cache feed seeded from the hottest column, recorded through the
+    # SAME stacked program the batcher uses — feed hits therefore stay
+    # bit-identical to per-request compute. Mid-run the cache is
+    # force-evicted: later hot-column lookups raise and the service
+    # falls back to recomputation (the spill-replay degrade contract).
+    hot_col = [sg for sg in subgrid_configs if sg.off0 == hot_off0]
+    stacked = fwd.get_subgrid_tasks(hot_col)
+    spill = SpillCache(budget_bytes=2**30)
+    spill.begin_fill(tag=("serve-seed", name, len(hot_col)))
+    spill.put(
+        [list(enumerate(hot_col))],
+        np.stack([np.asarray(r) for r in stacked])[None],
+    )
+    spill.end_fill()
+    feed = CachedColumnFeed(spill)
+
+    inject_state = {"armed": 0, "fired": 0}
+
+    def injector(reqs, attempt):
+        if attempt == 0 and inject_state["armed"] > 0:
+            inject_state["armed"] -= 1
+            inject_state["fired"] += 1
+            raise RuntimeError("injected transient device failure")
+
+    service = SubgridService(
+        fwd,
+        queue=AdmissionQueue(max_depth=max_depth),
+        scheduler=CoalescingScheduler(
+            max_batch=max_batch, urgency_s=0.05
+        ),
+        cache_feed=feed,
+        max_retries=2,
+        slo_ms=slo_ms,
+        fault_injector=injector,
+    )
+
+    if not smoke_mode:
+        # move the bucket-shape compiles off the latency path: the
+        # power-of-two batch buckets plus the single-request program
+        b = 1
+        while b <= min(max_batch, len(hot_col) * 2):
+            fwd.get_subgrid_tasks([hot_col[0]] * b)
+            b *= 2
+        fwd.get_subgrid_task(hot_col[0])
+
+    rng = np.random.default_rng(seed + 1)
+    tracked = []
+    # burst 0 intentionally overflows the admission queue (depth
+    # max_depth against a 1.5x burst): sheds are part of the drill
+    bursts = [workload[: int(max_depth * 1.5)]]
+    rest = workload[int(max_depth * 1.5):]
+    burst_n = int(os.environ.get("BENCH_SERVE_BURST", "20"))
+    bursts += [
+        rest[i : i + burst_n] for i in range(0, len(rest), burst_n)
+    ]
+    poisoned = SubgridConfig(
+        hot_off0, hot_col[0].off1, hot_col[0].size,
+        np.ones(hot_col[0].size + 3), None,
+    )
+    t0 = time.time()
+    for k, burst in enumerate(bursts):
+        if k == 2:
+            spill.reset()  # forced eviction: feed index now dangles
+        if k == 3:
+            inject_state["armed"] = 1  # fail the next coalesced batch
+        for sg in burst:
+            tracked.append(
+                (
+                    sg,
+                    service.submit(
+                        sg,
+                        priority=int(rng.integers(0, 4)),
+                        deadline_s=(
+                            None if rng.integers(0, 7) else 120.0
+                        ),
+                    ),
+                )
+            )
+        if k == 3:
+            tracked.append((poisoned, service.submit(poisoned)))
+        while service.pump_once():
+            pass
+    wall = time.time() - t0
+
+    # bit-identity audit: every served result vs per-request
+    # get_subgrid_task on a FRESH forward (fresh LRU, fresh queue)
+    _config2, fwd_ref, _fc2, _sg2, _src2 = _build("planar", params, dtype)
+    ref_cache = {}
+    checked = mismatches = 0
+    for sg, req in tracked:
+        res = req.result
+        if res is None or not res.ok:
+            continue
+        key = (sg.off0, sg.off1)
+        if key not in ref_cache:
+            ref_cache[key] = np.asarray(fwd_ref.get_subgrid_task(sg))
+        checked += 1
+        if not np.array_equal(np.asarray(res.data), ref_cache[key]):
+            mismatches += 1
+
+    stats = service.stats()
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    record = {
+        "metric": (
+            f"{name} on-demand subgrid serving "
+            f"({stats['n_requests']} zipf requests over {n_cols} "
+            f"columns, planar f32, {platform})"
+        ),
+        "value": round(wall, 4),
+        "unit": "s",
+        "throughput_rps": round(stats["n_served"] / wall, 2) if wall else 0.0,
+        **stats,
+        "bit_identical": {"checked": checked, "mismatches": mismatches},
+        "fault_drill": {
+            "forced_evictions": feed.evicted,
+            "injected_failures": inject_state["fired"],
+            "poisoned_quarantined": stats["n_quarantined"],
+            "queue_drained": len(service.queue) == 0,
+        },
+        "cache_feed": {
+            "indexed": len(feed),
+            "hits": feed.hits,
+            "misses": feed.misses,
+            "evicted": feed.evicted,
+        },
+        "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
+        "includes_compile": smoke_mode,
+        "n_subgrids_cover": len(subgrid_configs),
+        "dispatch_path": _api.last_dispatch_path(),
+        "manifest": run_manifest(
+            params={"config": name, "mode": "serve", **params},
+        ),
+    }
+    if metrics.enabled():
+        record["telemetry"] = metrics.export()
+
+    problems = validate_serve_artifact(record)
+    if smoke_mode:
+        # drill outcomes: schema alone is not proof the paths ran
+        if stats["n_served"] < 200:
+            problems.append(f"served {stats['n_served']} < 200 requests")
+        if mismatches or checked < stats["n_served"]:
+            problems.append(
+                f"bit-identity audit failed: {mismatches} mismatches, "
+                f"{checked}/{stats['n_served']} checked"
+            )
+        if not stats["shed_rate"] > 0:
+            problems.append("overload burst shed nothing (shed_rate == 0)")
+        if not stats["coalesce_hit_rate"] > 0:
+            problems.append("no coalesced requests (hit_rate == 0)")
+        if not stats["cache_hits"]:
+            problems.append("cache feed served no hits")
+        if not stats["cache_fallbacks"]:
+            problems.append(
+                "forced eviction produced no cache->compute fallback"
+            )
+        if not inject_state["fired"] or not stats["retries"]:
+            problems.append(
+                f"injected failure did not exercise the retry path "
+                f"(fired={inject_state['fired']}, "
+                f"retries={stats['retries']})"
+            )
+        if stats["n_quarantined"] != 1:
+            problems.append(
+                f"expected exactly 1 quarantined (poisoned) request, "
+                f"got {stats['n_quarantined']}"
+            )
+        if len(service.queue) != 0:
+            problems.append(f"queue wedged: {len(service.queue)} pending")
+        telemetry = record.get("telemetry") or {}
+        t_stages = telemetry.get("stages") or {}
+        if not {"serve.batch", "serve.request"} <= set(t_stages):
+            problems.append(
+                f"missing serve stages in telemetry: {sorted(t_stages)}"
+            )
+        elif "p50_s" not in t_stages["serve.request"]:
+            problems.append("serve.request stage missing p50_s")
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2)
+    if smoke_mode:
+        metrics.disable()
+        print(
+            json.dumps(
+                {
+                    "serve_smoke": "ok" if not problems else "failed",
+                    "config": name,
+                    "artifact": out_path,
+                    "n_served": stats["n_served"],
+                    "p99_ms": stats["p99_ms"],
+                    "shed_rate": stats["shed_rate"],
+                    "coalesce_hit_rate": stats["coalesce_hit_rate"],
+                    "problems": problems,
+                }
+            ),
+            flush=True,
+        )
+        return 0 if not problems else 1
+    print(json.dumps(record), flush=True)
+    return 0 if not problems else 1
+
+
 def smoke():
     """Fast schema-validation leg (`bench.py --smoke`, wired into the
     tier-1 tests): run the 1k round trip with telemetry ON, write the
@@ -1259,6 +1557,8 @@ def main():
     from swiftly_tpu.obs import PartialArtifactWriter
     from swiftly_tpu.utils import enable_compilation_cache
 
+    if "--serve" in sys.argv:
+        sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         sys.exit(smoke())
 

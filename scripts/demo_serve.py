@@ -1,0 +1,182 @@
+"""On-demand serving demo: a zipf request stream against SubgridService.
+
+The serving counterpart of demo_api.py: builds a facet cover from
+random sources, wraps the prepared forward in
+`swiftly_tpu.serve.SubgridService` (bounded admission queue +
+column-coalescing scheduler), replays a zipf-over-columns request
+trace in bursts, and prints the latency-SLO stats block plus the obs
+counters — the smallest end-to-end view of the serving path.
+
+Usage:
+    python scripts/demo_serve.py --swift_config 1k[1]-n512-256
+    python scripts/demo_serve.py --swift_config 4k[1]-n2k-512 \
+        --backend planar --precision f32 --requests 1000 --threaded
+"""
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from scripts.utils import cli_parser, make_sources, setup_jax
+
+log = logging.getLogger("swiftly-tpu.demo-serve")
+
+
+def main(argv=None):
+    parser = cli_parser("On-demand subgrid serving demo")
+    parser.add_argument(
+        "--requests", type=int, default=200,
+        help="zipf workload length",
+    )
+    parser.add_argument(
+        "--zipf_s", type=float, default=1.1,
+        help="zipf exponent over the (shuffled) column popularity ranks",
+    )
+    parser.add_argument(
+        "--burst", type=int, default=20,
+        help="requests submitted per burst before pumping",
+    )
+    parser.add_argument(
+        "--max_batch", type=int, default=32,
+        help="coalescing cap per column dispatch",
+    )
+    parser.add_argument(
+        "--max_depth", type=int, default=128,
+        help="admission-queue depth (overflow sheds)",
+    )
+    parser.add_argument(
+        "--slo_ms", type=float, default=None,
+        help="latency SLO; violations are counted in the stats block",
+    )
+    parser.add_argument(
+        "--timeout_s", type=float, default=None,
+        help="service-wide per-request deadline",
+    )
+    parser.add_argument(
+        "--threaded", action="store_true",
+        help="run the pump on the service worker thread",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=1234,
+        help="workload seed",
+    )
+    args = parser.parse_args(argv)  # --metrics etc. come from cli_parser
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr,
+                        format="%(asctime)s %(name)s: %(message)s")
+    setup_jax(args)
+
+    from swiftly_tpu import (
+        SWIFT_CONFIGS,
+        SwiftlyConfig,
+        SwiftlyForward,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.obs import metrics
+    from swiftly_tpu.serve import (
+        AdmissionQueue,
+        CoalescingScheduler,
+        SubgridService,
+    )
+
+    if args.metrics:
+        metrics.enable(args.metrics_jsonl or None)
+
+    name = args.swift_config.split(",")[0]
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    dtype = np.float32 if args.precision == "f32" else np.float64
+    config = SwiftlyConfig(backend=args.backend, dtype=dtype, **params)
+    rng = np.random.default_rng(args.seed)
+    sources = make_sources(rng, 8, config.image_size)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    t0 = time.time()
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, sources))
+        for fc in facet_configs
+    ]
+    fwd = SwiftlyForward(
+        config, facet_tasks,
+        lru_forward=max(2, args.lru_forward),
+        queue_size=args.queue_size,
+    )
+    log.info("facets built in %.1fs; %d subgrids over %d columns",
+             time.time() - t0, len(subgrid_configs),
+             len({sg.off0 for sg in subgrid_configs}))
+
+    # zipf-over-columns trace: shuffled popularity ranking, p ∝ 1/rank^s
+    cols = sorted({sg.off0 for sg in subgrid_configs})
+    by_col = {}
+    for sg in subgrid_configs:
+        by_col.setdefault(sg.off0, []).append(sg)
+    order = rng.permutation(len(cols))
+    ranks = np.empty(len(cols), dtype=int)
+    ranks[order] = np.arange(len(cols))
+    p = 1.0 / (ranks + 1.0) ** args.zipf_s
+    p /= p.sum()
+    picks = rng.choice(len(cols), size=args.requests, p=p)
+    workload = [
+        by_col[cols[c]][rng.integers(len(by_col[cols[c]]))] for c in picks
+    ]
+
+    service = SubgridService(
+        fwd,
+        queue=AdmissionQueue(max_depth=args.max_depth),
+        scheduler=CoalescingScheduler(
+            max_batch=args.max_batch, urgency_s=0.05
+        ),
+        timeout_s=args.timeout_s,
+        slo_ms=args.slo_ms,
+    )
+    if args.threaded:
+        service.start()
+    reqs = []
+    t0 = time.time()
+    for i in range(0, len(workload), args.burst):
+        for sg in workload[i : i + args.burst]:
+            reqs.append(service.submit(
+                sg, priority=int(rng.integers(0, 4))
+            ))
+        if not args.threaded:
+            while service.pump_once():
+                pass
+    if args.threaded:
+        for r in reqs:
+            r.wait()
+        service.stop()
+    wall = time.time() - t0
+
+    stats = service.stats()
+    stats["wall_s"] = round(wall, 3)
+    stats["throughput_rps"] = (
+        round(stats["n_served"] / wall, 2) if wall else 0.0
+    )
+    print(json.dumps(stats, indent=2))
+    if args.metrics:
+        exported = metrics.export()
+        print(json.dumps(
+            {
+                "serve_counters": {
+                    k: v for k, v in exported["counters"].items()
+                    if k.startswith(("serve.", "lru."))
+                },
+                "serve_stages": {
+                    k: v for k, v in exported["stages"].items()
+                    if k.startswith("serve.")
+                },
+            },
+            indent=2,
+        ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ single-program batched execution over stacked facets.
 from __future__ import annotations
 
 import logging
+from collections import deque
 
 import numpy as np
 
@@ -52,6 +53,7 @@ __all__ = [
     "check_facet",
     "check_residual",
     "check_subgrid",
+    "last_dispatch_path",
     "make_facet",
     "make_real_facet",
     "make_full_facet_cover",
@@ -164,19 +166,35 @@ class LRUCache:
     `set` returns the evicted (key, value) once capacity is exceeded —
     eviction is what triggers the backward fold step. Parity: reference
     LRUCache (api.py:525-590).
+
+    Hit/miss counters (``<name>.hit`` / ``<name>.miss``, recorded only
+    while metrics are enabled) make column-cache effectiveness visible
+    in serve/bench telemetry — a serving workload whose column locality
+    the scheduler fails to exploit shows up as a rising ``lru.miss``.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, name: str = "lru"):
         self.capacity = capacity
         self._store = {}  # insertion-ordered; order == recency
+        self._hit_name = f"{name}.hit"
+        self._miss_name = f"{name}.miss"
 
     def get(self, key):
         """Return the cached value and refresh its recency, or None."""
         if key not in self._store:
+            if _metrics.enabled():
+                _metrics.count(self._miss_name)
             return None
+        if _metrics.enabled():
+            _metrics.count(self._hit_name)
         value = self._store.pop(key)
         self._store[key] = value
         return value
+
+    def keys(self):
+        """Cached keys, oldest first (recency order) — the serving
+        scheduler's column-locality signal."""
+        return list(self._store)
 
     def set(self, key, value):
         """Insert/refresh; returns (evicted_key, evicted_value) or
@@ -219,7 +237,10 @@ class FlightQueue:
         import os
 
         self.depth = depth
-        self._inflight = []
+        # deque: the queue drains oldest-first on every admit past the
+        # bound, and list.pop(0) is O(n) per pop — O(n^2) across a long
+        # serving session's stream of admissions
+        self._inflight = deque()
         # On runtimes whose block_until_ready returns before the dispatch
         # queue has drained (the tunnel-attached TPU this repo benches
         # on), blocking is not backpressure. With SWIFTLY_QUEUE_CHECKSUM=1
@@ -249,12 +270,12 @@ class FlightQueue:
             arrays = [arrays]
         self._inflight.extend(arrays)
         while len(self._inflight) > self.depth:
-            self._ready(self._inflight.pop(0))
+            self._ready(self._inflight.popleft())
 
     def drain(self):
         """Block until all in-flight work completes."""
         while self._inflight:
-            self._ready(self._inflight.pop(0))
+            self._ready(self._inflight.popleft())
 
 
 # ---------------------------------------------------------------------------
@@ -329,6 +350,42 @@ def _place(core, mesh, arr, shard_facets: bool):
 
 def _use_shard_map(config):
     return getattr(config, "spmd_mode", "shard_map") == "shard_map"
+
+
+# Which execution path served the latest column-batched forward request.
+# Silent degradation is the failure mode here: `get_subgrid_tasks` falls
+# back to the per-subgrid loop on host backends, and a serving/bench run
+# that quietly took the slow path produces numbers nobody can interpret.
+# The fallback therefore warns ONCE per reason and the executed path is
+# recorded (gauge `fwd.dispatch_path` + `last_dispatch_path()`) so run
+# manifests can stamp how their requests were actually served.
+_LAST_DISPATCH_PATH = None
+_FALLBACK_WARNED = set()
+
+
+def last_dispatch_path():
+    """The path the most recent batched-forward call executed:
+    ``"batched-column"``, ``"sharded-column"``, or the host
+    ``"per-subgrid-loop"`` fallback (None before any call)."""
+    return _LAST_DISPATCH_PATH
+
+
+def _record_dispatch_path(path, fallback_reason=None):
+    global _LAST_DISPATCH_PATH
+    _LAST_DISPATCH_PATH = path
+    if _metrics.enabled():
+        _metrics.gauge("fwd.dispatch_path", path)
+        _metrics.count(f"fwd.path.{path}")
+    if fallback_reason and fallback_reason not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(fallback_reason)
+        log.warning(
+            "get_subgrid_tasks falling back to the per-subgrid loop "
+            "(%s): column batching unavailable — O(subgrids) dispatches "
+            "instead of O(columns)", fallback_reason,
+        )
+        _metrics.event(
+            "fwd.path_fallback", path=path, reason=fallback_reason
+        )
 
 
 def _subgrid_masks(sg_config):
@@ -491,7 +548,16 @@ class SwiftlyForward:
         subgrids in input order.
         """
         if self.core.backend in ("numpy", "native"):
+            _record_dispatch_path(
+                "per-subgrid-loop",
+                fallback_reason=f"backend={self.core.backend!r}",
+            )
             return [self.get_subgrid_task(sg) for sg in subgrid_configs]
+        _record_dispatch_path(
+            "sharded-column"
+            if self.mesh is not None and _use_shard_map(self.config)
+            else "batched-column"
+        )
         groups = {}  # (off0, size) -> list of input indices
         for i, sg in enumerate(subgrid_configs):
             groups.setdefault((sg.off0, sg.size), []).append(i)

@@ -27,7 +27,11 @@ Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
 
 from . import metrics
 from .heartbeat import Heartbeat, PartialArtifactWriter
-from .manifest import run_manifest, validate_artifact
+from .manifest import (
+    run_manifest,
+    validate_artifact,
+    validate_serve_artifact,
+)
 
 __all__ = [
     "Heartbeat",
@@ -35,4 +39,5 @@ __all__ = [
     "metrics",
     "run_manifest",
     "validate_artifact",
+    "validate_serve_artifact",
 ]

@@ -28,9 +28,11 @@ import time
 __all__ = [
     "BASELINE_SOURCES",
     "MANIFEST_SCHEMA",
+    "SERVE_ARTIFACT_FIELDS",
     "config_hash",
     "run_manifest",
     "validate_artifact",
+    "validate_serve_artifact",
 ]
 
 MANIFEST_SCHEMA = "swiftly-tpu-run-manifest/1"
@@ -180,4 +182,55 @@ def validate_artifact(record, require_baseline=True):
     for field in ("metric", "value", "unit"):
         if field not in record:
             problems.append(f"missing metric field {field!r}")
+    return problems
+
+
+# The latency-SLO block every `bench.py --serve` artifact must carry
+# (`SubgridService.stats()` flattened into the record) — the serving
+# workload's schema contract, guarded by the --serve --smoke leg the
+# same way validate_artifact guards the batch legs.
+SERVE_ARTIFACT_FIELDS = (
+    "p50_ms",
+    "p99_ms",
+    "shed_rate",
+    "coalesce_hit_rate",
+    "throughput_rps",
+    "n_requests",
+    "n_served",
+)
+
+
+def validate_serve_artifact(record):
+    """Problems with a serve-mode BENCH artifact, as a list of strings.
+
+    Serving legs carry no numpy baseline (there is no reference serving
+    implementation to race) but must carry the full manifest plus the
+    SLO metric block, with rates in [0, 1] and a coherent latency
+    ordering — schema drift in the serving telemetry fails in seconds
+    on CPU, not in a production latency regression nobody can read.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    for field in SERVE_ARTIFACT_FIELDS:
+        if field not in record:
+            problems.append(f"missing serve field {field!r}")
+    for rate in ("shed_rate", "coalesce_hit_rate"):
+        v = record.get(rate)
+        if v is not None and not (0.0 <= v <= 1.0):
+            problems.append(f"{rate} {v!r} outside [0, 1]")
+    p50, p99 = record.get("p50_ms"), record.get("p99_ms")
+    if (
+        isinstance(p50, (int, float))
+        and isinstance(p99, (int, float))
+        and p99 < p50
+    ):
+        problems.append(f"p99_ms {p99} < p50_ms {p50}")
+    if record.get("n_served") and not record.get("throughput_rps"):
+        problems.append("served requests but no throughput_rps")
+    bit = record.get("bit_identical")
+    if not isinstance(bit, dict) or not (
+        {"checked", "mismatches"} <= set(bit)
+    ):
+        problems.append(
+            "missing bit_identical {checked, mismatches} block"
+        )
     return problems

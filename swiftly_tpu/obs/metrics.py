@@ -42,6 +42,7 @@ __all__ = [
     "disable",
     "get_registry",
     "stage",
+    "observe",
     "count",
     "gauge",
     "event",
@@ -106,11 +107,14 @@ class _StageStats:
             self._ring_i = (self._ring_i + 1) % _P99_RING
 
 
-def _p99(samples):
-    if not samples:
+def _quantile_sorted(s, q):
+    if not s:
         return 0.0
-    s = sorted(samples)
-    return s[min(len(s) - 1, int(0.99 * len(s)))]
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _p99(samples):
+    return _quantile_sorted(sorted(samples), 0.99)
 
 
 class _Stage:
@@ -220,6 +224,18 @@ class MetricsRegistry:
             return _NULL_STAGE
         return _Stage(self, name, flops, bytes_moved)
 
+    def observe(self, name, wall_s, flops=0, bytes_moved=0):
+        """Record an externally measured duration into a stage histogram.
+
+        For durations the registry cannot bracket with ``stage(...)`` —
+        e.g. a serving request's submit→completion latency, whose span
+        crosses queueing, scheduling and dispatch. Lands in the same
+        export/quantile machinery as timed stages.
+        """
+        if not self.enabled:
+            return
+        self._record_stage(name, wall_s, flops, bytes_moved)
+
     def count(self, name, n=1):
         if not self.enabled:
             return
@@ -286,13 +302,15 @@ class MetricsRegistry:
             tot_bytes = 0
             for name in sorted(self.stages):
                 st = self.stages[name]
+                samples = sorted(st.samples)
                 entry = {
                     "count": st.count,
                     "total_s": round(st.total_s, 6),
                     "min_s": round(st.min_s, 6),
                     "mean_s": round(st.total_s / st.count, 6),
                     "max_s": round(st.max_s, 6),
-                    "p99_s": round(_p99(st.samples), 6),
+                    "p50_s": round(_quantile_sorted(samples, 0.50), 6),
+                    "p99_s": round(_quantile_sorted(samples, 0.99), 6),
                 }
                 if st.flops:
                     entry["flops"] = st.flops
@@ -371,6 +389,10 @@ def stage(name, flops=0, bytes_moved=0):
     if not _REGISTRY.enabled:  # keep the disabled path one check deep
         return _NULL_STAGE
     return _Stage(_REGISTRY, name, flops, bytes_moved)
+
+
+def observe(name, wall_s, flops=0, bytes_moved=0):
+    _REGISTRY.observe(name, wall_s, flops, bytes_moved)
 
 
 def count(name, n=1):

@@ -2,9 +2,10 @@
 out-of-core streamed executors."""
 
 from . import batched, sharded, streamed
-from .streamed import StreamedBackward, StreamedForward
+from .streamed import CachedColumnFeed, StreamedBackward, StreamedForward
 
 __all__ = [
+    "CachedColumnFeed",
     "StreamedBackward",
     "StreamedForward",
     "batched",

@@ -2033,6 +2033,86 @@ def _group_full_columns(subgrid_configs):
     return groups
 
 
+class CachedColumnFeed:
+    """On-demand lookups into a recorded subgrid stream.
+
+    The sequential sibling (`StreamedForward._replay_spilled_groups`)
+    feeds backward passes the whole stream in order; this feed is the
+    SERVING-path view of the same `utils.spill.SpillCache`: it indexes
+    every recorded subgrid by ``(off0, off1, size)`` at construction,
+    and `lookup` returns one host row — a RAM slice or a single-row
+    memmap read for disk-backed entries — so an individual request is
+    answered without a device dispatch and without materialising a
+    whole group stack.
+
+    Exactness contract: a hit is a verbatim copy of the recorded
+    stream's row (the cache stores plain float arrays), so a feed-served
+    request is bit-identical to the streamed forward that recorded it.
+    A config whose offsets match but whose masks differ from the
+    recorded one is a MISS (masks are part of the result), as is any
+    config the stream never covered. A hit whose backing entry has been
+    evicted since indexing raises LookupError — consumers
+    (`serve.SubgridService`) treat that as the signal to fall back to
+    recomputation, the serving twin of the cache's degrade-to-replay
+    contract.
+    """
+
+    def __init__(self, spill):
+        if not getattr(spill, "complete", False):
+            raise ValueError(
+                "CachedColumnFeed requires a COMPLETE spill cache "
+                "(begin_fill/put/end_fill with nothing evicted); an "
+                "incomplete stream would silently miss-serve"
+            )
+        self._spill = spill
+        self._index = {}  # (off0, off1, size) -> (k, c, s, recorded cfg)
+        for k in range(len(spill)):
+            for c, col in enumerate(spill.meta(k)):
+                for s, (_i, sg) in enumerate(col):
+                    self._index[(sg.off0, sg.off1, sg.size)] = (k, c, s, sg)
+        self.hits = 0
+        self.misses = 0
+        self.evicted = 0
+
+    def __len__(self):
+        return len(self._index)
+
+    @staticmethod
+    def _masks_match(a, b):
+        ma = np.ones(a.size) if a.mask0 is None else np.asarray(a.mask0)
+        mb = np.ones(b.size) if b.mask0 is None else np.asarray(b.mask0)
+        if not np.array_equal(ma, mb):
+            return False
+        ma = np.ones(a.size) if a.mask1 is None else np.asarray(a.mask1)
+        mb = np.ones(b.size) if b.mask1 is None else np.asarray(b.mask1)
+        return np.array_equal(ma, mb)
+
+    def lookup(self, config):
+        """The recorded host row for ``config``, or None on a miss;
+        raises LookupError when the index hit an evicted entry."""
+        hit = self._index.get((config.off0, config.off1, config.size))
+        if hit is None or not self._masks_match(config, hit[3]):
+            self.misses += 1
+            if _metrics.enabled():
+                _metrics.count("spill.feed_misses")
+            return None
+        k, c, s, _cfg = hit
+        try:
+            row = self._spill.get_row(k, (c, s))
+        except (IndexError, FileNotFoundError, OSError) as exc:
+            self.evicted += 1
+            if _metrics.enabled():
+                _metrics.count("spill.feed_evictions")
+            raise LookupError(
+                f"recorded stream entry {k} for subgrid "
+                f"({config.off0}, {config.off1}) was evicted"
+            ) from exc
+        self.hits += 1
+        if _metrics.enabled():
+            _metrics.count("spill.feed_hits")
+        return row
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -2345,6 +2425,14 @@ class StreamedForward:
             spill.end_fill()
         finally:
             self.spill_out_stacks = 0
+
+    def cached_feed(self, spill):
+        """A `CachedColumnFeed` over a stream this forward recorded —
+        the on-demand serving view (`swiftly_tpu.serve`) of the same
+        cache the partitioned backward consumes sequentially. Requires
+        a complete fill (one prior `stream_column_groups(spill=...)`
+        pass)."""
+        return CachedColumnFeed(spill)
 
     def _spill_store(self, spill, per_col, out_g):
         """Copy one yielded group's stack to the cache (d2h + put)."""

@@ -1,0 +1,57 @@
+"""On-demand subgrid serving: scheduler, batcher, SLO instrumentation.
+
+The batch drivers (`bench.py`, `scripts/demo_api.py`) enumerate a full
+cover; this package serves *individual subgrid requests arriving over
+time* — the ROADMAP's "heavy traffic" workload — while keeping device
+programs batched and dense (the TPU-DFT throughput discipline of
+arXiv:2002.03260 applied to ragged demand):
+
+* `serve.queue.AdmissionQueue` — bounded admission with backpressure:
+  depth cap plus a projected-HBM cost model; overload sheds at the
+  door instead of growing latency without bound;
+* `serve.scheduler.CoalescingScheduler` — groups pending requests by
+  subgrid column (``off0``) so ONE ``extract_columns_batch`` + one
+  stacked column program serves every subgrid in the column; prefers
+  LRU-hot columns (locality) and preempts for urgent deadlines;
+* `serve.service.SubgridService` — the long-lived server: wraps a
+  prepared `SwiftlyForward` (+ optional recorded-stream cache feed),
+  enforces per-request timeouts, isolates and retries batch failures,
+  quarantines poisoned requests, and exports latency SLO metrics
+  (p50/p99, shed rate, coalesce-hit rate) through ``obs``.
+
+Entry points: build a `SwiftlyForward`, wrap it in a `SubgridService`,
+then ``submit(config).wait()`` (worker-thread mode via ``start()``) or
+``serve([...])`` / ``pump_once()`` (synchronous). ``bench.py --serve``
+replays a zipf-over-columns workload through this stack and stamps the
+SLO block into its artifact. See docs/serving.md.
+"""
+
+from .queue import (
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    STATUS_SHED,
+    AdmissionQueue,
+    RequestResult,
+    SubgridRequest,
+)
+from .scheduler import CoalescingScheduler
+from .service import (
+    SubgridService,
+    projected_column_bytes,
+    projected_request_bytes,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "CoalescingScheduler",
+    "RequestResult",
+    "SubgridRequest",
+    "SubgridService",
+    "STATUS_EXPIRED",
+    "STATUS_OK",
+    "STATUS_QUARANTINED",
+    "STATUS_SHED",
+    "projected_column_bytes",
+    "projected_request_bytes",
+]

@@ -1,0 +1,477 @@
+"""`SubgridService`: a long-lived, fault-isolated subgrid server.
+
+Wraps a prepared `SwiftlyForward` (facets uploaded once, column LRU
+warm across requests) behind an `AdmissionQueue` and a
+`CoalescingScheduler`, and serves individual subgrid requests arriving
+over time — the ROADMAP's serving workload, where the batch drivers
+enumerate a full cover instead. One pump cycle:
+
+1. time out overdue requests (queue deadlines / the service-wide
+   ``timeout_s``) — a request never occupies a dispatch after its
+   caller stopped waiting;
+2. pick the next column (urgency > LRU locality > batch density, see
+   `CoalescingScheduler`) and take up to ``max_batch`` of its requests;
+3. serve what it can from the optional **cache feed** (a
+   `parallel.streamed.CachedColumnFeed` over a recorded subgrid
+   stream) — a feed hit is one host-RAM row read, no device dispatch;
+   a feed *eviction* falls through to the compute path (the
+   spill-replay fallback: a capacity miss degrades to recomputation,
+   never to an error);
+4. compute the rest as ONE stacked column program
+   (`SwiftlyForward.get_subgrid_tasks` — bit-identical to per-request
+   ``get_subgrid_task``, pinned by tests), bucket-padded so compile
+   shapes stay bounded;
+5. on a batch failure, **isolate**: retry each request singly (up to
+   ``max_retries``); a request that keeps failing is *quarantined*
+   with a structured error result — one poisoned config (bad mask,
+   impossible offsets) can never wedge the queue behind it.
+
+Fused multi-column dispatch (``fuse_columns > 1``) trades per-request
+latency for fewer dispatches via `SwiftlyForward.all_subgrids` (the
+`_group_columns` + `_pad_ragged_columns` whole-cover path).
+
+SLO instrumentation: per-request latency histogram (p50/p99 via
+``obs.metrics.observe("serve.request", ...)`` plus the service's own
+quantile ring for metrics-off runs), queue-depth gauge, shed/coalesce/
+cache counters, and ``stats()`` — the JSON-ready block ``bench.py
+--serve`` stamps into its artifact (``p50_ms``/``p99_ms``/
+``shed_rate``/``coalesce_hit_rate``).
+
+Threading: ``pump_once``/``serve`` for synchronous (test/bench) use;
+``start()``/``stop()`` run the pump on a background worker so client
+threads just ``submit(...).wait()``. Timeouts are enforced at
+scheduling boundaries — an already-dispatched device program is never
+preempted (XLA offers no cancellation), so a timed-out request's
+compute may still run to completion; its result is discarded.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from .queue import (
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    STATUS_SHED,
+    AdmissionQueue,
+    RequestResult,
+    SubgridRequest,
+)
+from .scheduler import CoalescingScheduler
+
+__all__ = ["SubgridService", "projected_request_bytes",
+           "projected_column_bytes"]
+
+log = logging.getLogger("swiftly-tpu.serve")
+
+_LATENCY_RING = 65536  # newest-wins latency samples kept for quantiles
+
+
+def _per_element_bytes(core):
+    return np.dtype(core.dtype).itemsize * (
+        2 if core.backend == "planar" else 1
+    )
+
+
+def projected_request_bytes(config):
+    """Projected HBM bytes of one finished subgrid (queue cost model)."""
+    return config.max_subgrid_size ** 2 * _per_element_bytes(config.core)
+
+
+def projected_column_bytes(fwd):
+    """Projected HBM bytes of one column's intermediates — the
+    [F, m, yN] ``extract_columns_batch`` product a pending column will
+    materialise (queue cost model)."""
+    core = fwd.core
+    return (
+        len(fwd.stack) * core.xM_yN_size * core.yN_size
+        * _per_element_bytes(core)
+    )
+
+
+def _quantile(sorted_samples, q):
+    if not sorted_samples:
+        return 0.0
+    i = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
+    return sorted_samples[i]
+
+
+class SubgridService:
+    """Serve individual subgrid requests through a shared forward.
+
+    :param fwd: a prepared `SwiftlyForward` (its facet stack and column
+        LRU are the service's working set)
+    :param queue: `AdmissionQueue`; default bounds depth at 256 with
+        the cost model priced from ``fwd`` when ``hbm_budget_bytes``
+        is given
+    :param scheduler: `CoalescingScheduler`; default coalesces up to 64
+        requests per column dispatch with bucket padding
+    :param cache_feed: optional recorded-stream feed (an object with
+        ``lookup(config) -> row | None``, raising LookupError when the
+        looked-up entry was evicted) — e.g.
+        `parallel.streamed.CachedColumnFeed`
+    :param timeout_s: service-wide per-request deadline applied at
+        submit (min'd with the request's own ``deadline_s``)
+    :param max_retries: single-request retry attempts after a batch
+        failure before quarantine
+    :param fuse_columns: columns per dispatch; > 1 uses the fused
+        whole-cover program (`all_subgrids`) over several columns
+    :param slo_ms: latency SLO — served requests slower than this are
+        counted as violations in ``stats()``
+    :param fault_injector: test/chaos hook ``fn(requests, attempt)``
+        called before each dispatch (attempt 0 = coalesced batch,
+        >= 1 = isolated retries); an exception it raises is handled
+        exactly like a compute failure
+    """
+
+    def __init__(self, fwd, queue=None, scheduler=None, cache_feed=None,
+                 timeout_s=None, max_retries=2, fuse_columns=1,
+                 slo_ms=None, fault_injector=None,
+                 hbm_budget_bytes=None, max_depth=256):
+        self.fwd = fwd
+        if queue is None:
+            queue = AdmissionQueue(
+                max_depth=max_depth,
+                hbm_budget_bytes=hbm_budget_bytes,
+                request_bytes=projected_request_bytes(fwd.config),
+                column_bytes=projected_column_bytes(fwd),
+            )
+        self.queue = queue
+        self.scheduler = scheduler or CoalescingScheduler()
+        self.cache_feed = cache_feed
+        self.timeout_s = timeout_s
+        self.max_retries = int(max_retries)
+        self.fuse_columns = int(fuse_columns)
+        self.slo_ms = slo_ms
+        self.fault_injector = fault_injector
+        self.quarantined = []  # [(request, error_repr), ...]
+        self._counts = {
+            "requests": 0, "served": 0, "shed": 0, "expired": 0,
+            "quarantined": 0, "retries": 0, "batches": 0,
+            "batch_failures": 0, "coalesced": 0, "cache_hits": 0,
+            "cache_fallbacks": 0, "slo_violations": 0,
+        }
+        self._shed_reasons = {}
+        self._latencies = []
+        self._lat_i = 0
+        self._pump_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread = None
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, config, priority=0, deadline_s=None):
+        """Admit one request; returns a `SubgridRequest` whose result is
+        set on completion. A shed request returns already-completed
+        (``status == "shed"``) — admission never blocks."""
+        if deadline_s is None:
+            deadline_s = self.timeout_s
+        elif self.timeout_s is not None:
+            deadline_s = min(deadline_s, self.timeout_s)
+        req = SubgridRequest(config, priority=priority,
+                            deadline_s=deadline_s)
+        self._counts["requests"] += 1
+        _metrics.count("serve.requests")
+        admitted, reason = self.queue.offer(req)
+        if not admitted:
+            if reason == "expired":
+                # dead on arrival is a deadline outcome, not backpressure
+                self._counts["expired"] += 1
+                _metrics.count("serve.expired")
+                req._complete(
+                    RequestResult(STATUS_EXPIRED, error="deadline")
+                )
+                return req
+            self._counts["shed"] += 1
+            self._shed_reasons[reason] = (
+                self._shed_reasons.get(reason, 0) + 1
+            )
+            _metrics.count("serve.shed")
+            _metrics.count(f"serve.shed.{reason}")
+            req._complete(
+                RequestResult(STATUS_SHED, shed_reason=reason)
+            )
+            return req
+        with self._cond:
+            self._cond.notify()
+        return req
+
+    def serve(self, configs, priority=0, deadline_s=None):
+        """Submit many configs and serve to completion; returns the
+        requests in input order (synchronous pump unless the worker
+        thread is running)."""
+        reqs = [
+            self.submit(c, priority=priority, deadline_s=deadline_s)
+            for c in configs
+        ]
+        if self._thread is None:
+            while self.pump_once():
+                pass
+        for r in reqs:
+            r.wait()
+        return reqs
+
+    # -- the pump -----------------------------------------------------------
+
+    def pump_once(self, now=None):
+        """Serve one coalesced dispatch; returns the number of requests
+        it brought to a terminal state (0 = nothing pending)."""
+        with self._pump_lock:
+            now = time.perf_counter() if now is None else now
+            handled = 0
+            for req in self.queue.take_expired(now):
+                self._finish(
+                    req, RequestResult(STATUS_EXPIRED, error="deadline")
+                )
+                self._counts["expired"] += 1
+                _metrics.count("serve.expired")
+                handled += 1
+            summaries = self.queue.columns()
+            if not summaries:
+                return handled
+            hot = set(self.fwd.lru.keys())
+            if self.fuse_columns > 1:
+                offs = self.scheduler.pick_columns(
+                    summaries, hot, now, self.fuse_columns
+                )
+                requests = []
+                for off0 in offs:
+                    requests.extend(
+                        self.queue.take(off0, limit=self.scheduler.max_batch)
+                    )
+            else:
+                off0 = self.scheduler.pick_column(summaries, hot, now)
+                requests = self.queue.take(
+                    off0, limit=self.scheduler.max_batch
+                )
+            if not requests:
+                return handled
+            remaining = requests
+            if self.cache_feed is not None:
+                remaining = self._serve_from_feed(requests)
+            if remaining:
+                self._execute(remaining)
+            return handled + len(requests)
+
+    def _serve_from_feed(self, requests):
+        """Serve what the recorded-stream feed holds; returns the
+        requests that still need compute (feed misses AND evictions —
+        the eviction fallback is the serving-path twin of the spill
+        cache's degrade-to-replay contract)."""
+        remaining = []
+        for req in requests:
+            try:
+                with _metrics.stage("serve.cache_feed"):
+                    row = self.cache_feed.lookup(req.config)
+            except LookupError:
+                # indexed but evicted: fall back to the compute path
+                self._counts["cache_fallbacks"] += 1
+                _metrics.count("serve.cache_fallbacks")
+                row = None
+            if row is None:
+                remaining.append(req)
+                continue
+            self._counts["cache_hits"] += 1
+            _metrics.count("serve.cache_hits")
+            self._finish(
+                req,
+                RequestResult(
+                    STATUS_OK, data=row, path="cache",
+                    batch_size=len(requests),
+                ),
+            )
+        return remaining
+
+    def _execute(self, requests):
+        """One coalesced dispatch for the taken requests, with
+        batch-failure isolation."""
+        self._counts["batches"] += 1
+        _metrics.count("serve.batches")
+        try:
+            if self.fault_injector is not None:
+                self.fault_injector(requests, 0)
+            with _metrics.stage("serve.batch"):
+                if self.fuse_columns > 1:
+                    configs, rows = self.scheduler.plan_fused(requests)
+                    flat = self.fwd.all_subgrids(configs)
+                    results = [flat[r] for r in rows]
+                else:
+                    configs, _n_pad = self.scheduler.plan_batch(requests)
+                    results = self.fwd.get_subgrid_tasks(configs)[
+                        : len(requests)
+                    ]
+        except Exception as exc:
+            self._counts["batch_failures"] += 1
+            _metrics.count("serve.batch_failures")
+            log.warning(
+                "coalesced batch of %d failed (%s: %s); isolating",
+                len(requests), type(exc).__name__, exc,
+            )
+            self._retry_singly(requests, exc)
+            return
+        coalesced = len(requests) > 1
+        if coalesced:
+            self._counts["coalesced"] += len(requests)
+            _metrics.count("serve.coalesce.hits", len(requests))
+        for req, data in zip(requests, results):
+            self._finish(
+                req,
+                RequestResult(
+                    STATUS_OK, data=data, path="coalesced",
+                    batch_size=len(requests), coalesced=coalesced,
+                ),
+            )
+
+    def _retry_singly(self, requests, batch_exc):
+        """Per-request isolation after a batch failure: each request
+        retries alone; persistent failures are quarantined so the rest
+        of the queue keeps flowing."""
+        for req in requests:
+            last_err = batch_exc
+            served = False
+            for attempt in range(1, self.max_retries + 1):
+                req.retries += 1
+                self._counts["retries"] += 1
+                _metrics.count("serve.retries")
+                try:
+                    if self.fault_injector is not None:
+                        self.fault_injector([req], attempt)
+                    data = self.fwd.get_subgrid_task(req.config)
+                except Exception as exc:  # noqa: BLE001 - isolation layer
+                    last_err = exc
+                    continue
+                self._finish(
+                    req,
+                    RequestResult(
+                        STATUS_OK, data=data, path="retry",
+                        batch_size=1, retries=req.retries,
+                    ),
+                )
+                served = True
+                break
+            if not served:
+                err = f"{type(last_err).__name__}: {last_err}"
+                self.quarantined.append((req, err))
+                self._counts["quarantined"] += 1
+                _metrics.count("serve.quarantined")
+                log.error(
+                    "request %r quarantined after %d retries: %s",
+                    req, req.retries, err,
+                )
+                self._finish(
+                    req,
+                    RequestResult(
+                        STATUS_QUARANTINED, error=err,
+                        retries=req.retries,
+                    ),
+                )
+
+    def _finish(self, req, result):
+        now = time.perf_counter()
+        result.latency_s = max(0.0, now - req.submit_t)
+        if result.ok:
+            self._counts["served"] += 1
+            _metrics.count("serve.served")
+            _metrics.observe("serve.request", result.latency_s)
+            if len(self._latencies) < _LATENCY_RING:
+                self._latencies.append(result.latency_s)
+            else:
+                self._latencies[self._lat_i] = result.latency_s
+                self._lat_i = (self._lat_i + 1) % _LATENCY_RING
+            if (
+                self.slo_ms is not None
+                and result.latency_s * 1e3 > self.slo_ms
+            ):
+                self._counts["slo_violations"] += 1
+                _metrics.count("serve.slo_violations")
+        req._complete(result)
+
+    # -- worker thread ------------------------------------------------------
+
+    def start(self):
+        """Run the pump on a background worker; clients just submit."""
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="subgrid-service", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while True:
+            n = self.pump_once()
+            if n:
+                continue
+            with self._cond:
+                if self._stop and not len(self.queue):
+                    return
+                self._cond.wait(timeout=0.02)
+
+    def stop(self, drain=True, timeout=None):
+        """Stop the worker; with ``drain`` the queue is served empty
+        first, otherwise pending requests are shed."""
+        if self._thread is None:
+            return
+        if not drain:
+            for req in self.queue.drain():
+                self._counts["shed"] += 1
+                _metrics.count("serve.shed")
+                req._complete(
+                    RequestResult(STATUS_SHED, shed_reason="shutdown")
+                )
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+        self._thread = None
+
+    # -- SLO export ---------------------------------------------------------
+
+    def stats(self):
+        """JSON-ready serving metrics (the ``bench.py --serve``
+        artifact block): request counts, shed/coalesce/cache rates,
+        latency quantiles in ms, SLO attainment."""
+        c = dict(self._counts)
+        lat = sorted(self._latencies)
+        served = c["served"]
+        requests = c["requests"]
+        out = {
+            "n_requests": requests,
+            "n_served": served,
+            "n_shed": c["shed"],
+            "n_expired": c["expired"],
+            "n_quarantined": c["quarantined"],
+            "n_batches": c["batches"],
+            "batch_failures": c["batch_failures"],
+            "retries": c["retries"],
+            "cache_hits": c["cache_hits"],
+            "cache_fallbacks": c["cache_fallbacks"],
+            "shed_rate": round(c["shed"] / requests, 4) if requests else 0.0,
+            "shed_reasons": dict(self._shed_reasons),
+            "coalesce_hit_rate": (
+                round(c["coalesced"] / served, 4) if served else 0.0
+            ),
+            "mean_batch": (
+                round(served / c["batches"], 2) if c["batches"] else 0.0
+            ),
+            "p50_ms": round(_quantile(lat, 0.50) * 1e3, 3),
+            "p99_ms": round(_quantile(lat, 0.99) * 1e3, 3),
+            "max_ms": round((lat[-1] if lat else 0.0) * 1e3, 3),
+        }
+        if self.slo_ms is not None:
+            out["slo_ms"] = self.slo_ms
+            out["slo_violations"] = c["slo_violations"]
+            out["slo_attainment"] = (
+                round(1.0 - c["slo_violations"] / served, 4)
+                if served else 1.0
+            )
+        return out

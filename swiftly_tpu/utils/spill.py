@@ -181,6 +181,27 @@ class SpillCache:
             st.bytes_moved = int(arr.nbytes)
         return arr
 
+    def get_row(self, k, index):
+        """One sub-array of entry k (e.g. ``(c, s)`` of a [G, S, ...]
+        group stack) without materialising the whole entry.
+
+        The serving path (`parallel.streamed.CachedColumnFeed`) reads
+        single subgrids out of recorded streams; RAM entries slice in
+        place and disk entries go through a read-only memmap, so a
+        one-subgrid request against a multi-GiB disk entry costs one
+        row's IO, not the entry's.
+        """
+        kind, payload = self._entries[k]
+        if kind == "ram":
+            self.counters["ram_reads"] += 1
+            return payload[index]
+        self.counters["disk_reads"] += 1
+        _metrics.count("spill.disk_reads")
+        with _metrics.stage("spill.disk_read") as st:
+            row = np.array(np.load(payload, mmap_mode="r")[index])
+            st.bytes_moved = int(row.nbytes)
+        return row
+
     # -- maintenance --------------------------------------------------------
 
     def reset(self):

@@ -185,6 +185,78 @@ def test_batched_backward_matches_per_subgrid():
     )
 
 
+def test_lru_cache_hit_miss_counters():
+    """LRUCache.get records <name>.hit / <name>.miss (enabled only),
+    and keys() exposes recency order for the serving scheduler."""
+    from swiftly_tpu.api import LRUCache
+    from swiftly_tpu.obs import metrics
+
+    lru = LRUCache(2)
+    lru.set("a", 1)
+    lru.set("b", 2)
+    metrics.reset()
+    metrics.enable()
+    try:
+        assert lru.get("a") == 1
+        assert lru.get("missing") is None
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    assert counters == {"lru.hit": 1, "lru.miss": 1}
+    assert lru.keys() == ["b", "a"]  # get("a") refreshed recency
+    # disabled: no counter mutation at all
+    assert lru.get("b") == 2
+    from swiftly_tpu.obs.metrics import export
+
+    assert "lru.hit" not in (export()["counters"] or {})
+
+
+def test_flight_queue_is_deque():
+    """The in-flight buffer drains oldest-first from a deque (the old
+    list.pop(0) was O(n) per admit over a serving session)."""
+    from collections import deque
+
+    from swiftly_tpu.api import FlightQueue
+
+    q = FlightQueue(4)
+    assert isinstance(q._inflight, deque)
+
+
+def test_get_subgrid_tasks_fallback_warns_once_and_records_path(caplog):
+    """The host-backend per-subgrid fallback warns ONCE and the
+    executed dispatch path is queryable for run manifests."""
+    import logging
+
+    from swiftly_tpu import api as api_mod
+    from swiftly_tpu.obs import metrics
+
+    config = SwiftlyConfig(backend="numpy", **TEST_PARAMS)
+    sgs = make_full_subgrid_cover(config)[:2]
+    fcs = make_full_facet_cover(config)
+    tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES)) for fc in fcs
+    ]
+    fwd = SwiftlyForward(config, tasks, 1, 10)
+    api_mod._FALLBACK_WARNED.clear()
+    metrics.reset()
+    metrics.enable()
+    try:
+        with caplog.at_level(logging.WARNING, logger="swiftly-tpu"):
+            fwd.get_subgrid_tasks(sgs)
+            fwd.get_subgrid_tasks(sgs)
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    warnings = [
+        r for r in caplog.records if "per-subgrid loop" in r.getMessage()
+    ]
+    assert len(warnings) == 1  # one-shot, however many calls
+    assert api_mod.last_dispatch_path() == "per-subgrid-loop"
+    assert counters["fwd.path.per-subgrid-loop"] == 2
+
+
 def test_flight_queue_checksum_fallback(monkeypatch):
     """With SWIFTLY_QUEUE_CHECKSUM=1 the queue bounds in-flight work by
     genuine element pulls even when block_until_ready lies (returns

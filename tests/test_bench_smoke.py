@@ -81,3 +81,59 @@ def test_bench_smoke_leg(tmp_path):
         if r.get("kind") == "stage"
     }
     assert len({s for s in names if s.startswith(("fwd.", "bwd."))}) >= 6
+
+
+def test_bench_serve_smoke_leg(tmp_path):
+    """The `bench.py --serve --smoke` leg: a zipf-over-columns workload
+    served through the coalescing scheduler on CPU, with the latency-SLO
+    artifact schema (p50/p99/shed/coalesce), bit-identity vs per-request
+    `get_subgrid_task`, and the fault drill (overload shed, forced cache
+    eviction, injected batch failure, poisoned-request quarantine) all
+    validated in a fresh interpreter — serving schema drift fails here,
+    in tier-1, not in a production latency regression."""
+    out = tmp_path / "BENCH_serve.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_SERVE_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--serve", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["serve_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["n_served"] >= 200
+
+    # re-validate the artifact out-of-process (the smoke's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_serve_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_serve_artifact(record) == []
+    assert record["bit_identical"]["mismatches"] == 0
+    assert record["bit_identical"]["checked"] == record["n_served"]
+    assert record["shed_rate"] > 0
+    assert record["coalesce_hit_rate"] > 0
+    assert record["p99_ms"] >= record["p50_ms"] > 0
+    assert record["throughput_rps"] > 0
+    drill = record["fault_drill"]
+    assert drill["queue_drained"]
+    assert drill["forced_evictions"] >= 1
+    assert drill["injected_failures"] == 1
+    assert drill["poisoned_quarantined"] == 1
+    assert record["cache_feed"]["hits"] >= 1
+    assert record["dispatch_path"] == "batched-column"
+    assert record["manifest"]["device"]["platform"] == "cpu"
+    telemetry = record["telemetry"]
+    assert telemetry["stages"]["serve.request"]["count"] == record[
+        "n_served"
+    ]
+    counters = telemetry["counters"]
+    assert counters["serve.coalesce.hits"] >= 1
+    assert counters["serve.quarantined"] == 1
+    assert counters["lru.hit"] >= 1 and counters["lru.miss"] >= 1
