@@ -1551,6 +1551,293 @@ def smoke():
     return 0 if not problems else 1
 
 
+def run_chaos_drill(config_name, fault_plan=None, fold_group=2,
+                    col_group=2):
+    """The kill-and-resume chaos drill (`bench.py --chaos`, also driven
+    by scripts/chaos_drill.py).
+
+    1. Run a facet-partitioned sampled streamed backward UNDISTURBED
+       (pass 1 records the subgrid stream into the spill cache, pass 2
+       is cache-fed) — the reference facets, computed with NO fault
+       plan installed (the clean path must stay hook-free).
+    2. Re-run under an injected fault schedule: transient spill-read
+       and h2d/d2h transfer IOErrors (the retry layer must absorb
+       them), per-group checkpoint autosave, a bit-flipped newest
+       checkpoint generation (restore must fall back a generation), and
+       a worker death mid-pass-2 (`WorkerKilled` tears through every
+       isolation layer).
+    3. RESUME: fresh backward, restore from the surviving generation,
+       skip the processed groups, finish.
+    4. Assert the chaos run's facets are BIT-IDENTICAL to the
+       undisturbed run's, and stamp the resilience block (faults
+       injected/survived, retries, degradations, resume count) into a
+       BENCH-style artifact validated by `obs.validate_resilience_artifact`.
+
+    Bit-identity holds because every fold is deterministic and the
+    ledger/autosave tick lands at column-GROUP boundaries only: the
+    resumed feed re-dispatches exactly the fold programs the killed run
+    would have, on a CRC-verified bit-exact accumulator.
+    """
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from swiftly_tpu import SWIFT_CONFIGS
+    from swiftly_tpu.obs import metrics
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.resilience import (
+        FaultPlan,
+        WorkerKilled,
+        degrade,
+        faults,
+    )
+    from swiftly_tpu.utils.checkpoint import (
+        checkpoint_generations,
+        restore_streamed_backward_state,
+    )
+    from swiftly_tpu.utils.spill import SpillCache
+
+    params = dict(SWIFT_CONFIGS[config_name])
+    params.setdefault("fov", 1.0)
+    config, fwd, facet_configs, subgrid_configs, _sources = _build(
+        "planar", params, jnp.float32, streamed=True
+    )
+    # deterministic column-group count: the fault schedule is indexed by
+    # site call number, so the drill pins the group size instead of
+    # letting the auto-sizer pick per-host values
+    fwd.col_group = col_group
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    n_groups = -(-n_cols // col_group)
+    if n_groups < 3:
+        raise ValueError(
+            f"chaos drill needs >= 3 column groups for its schedule "
+            f"(kill after 2 autosaves); {config_name} with "
+            f"col_group={col_group} has {n_groups}"
+        )
+    F = len(facet_configs)
+    half = max(1, F // 2)
+    subsets = [(0, half), (half, F)] if F > 1 else [(0, F)]
+
+    work_dir = tempfile.mkdtemp(prefix="chaos_drill_")
+    ck_paths = [
+        os.path.join(work_dir, f"ck_pass{i}.npz")
+        for i in range(len(subsets))
+    ]
+
+    def feed(bwd, spill, skip=()):
+        skip = set(skip)
+        for per_col, group in fwd.stream_column_groups(
+            subgrid_configs, spill=spill
+        ):
+            keys = [
+                (sg.off0, sg.off1) for col in per_col for _, sg in col
+            ]
+            if skip and all(k in skip for k in keys):
+                continue
+            bwd.add_subgrid_group(
+                [[sg for _, sg in col] for col in per_col], group
+            )
+
+    def run_passes(spill, autosave=False, resume=False):
+        outs = []
+        for idx, (i0, i1) in enumerate(subsets):
+            bwd = StreamedBackward(
+                config, list(facet_configs[i0:i1]),
+                residency="sampled", fold_group=fold_group,
+            )
+            skip = ()
+            if resume and checkpoint_generations(ck_paths[idx]):
+                skip = restore_streamed_backward_state(
+                    ck_paths[idx], bwd
+                )
+            if autosave:
+                bwd.enable_autosave(ck_paths[idx], every_subgrids=1)
+            feed(bwd, spill, skip)
+            outs.append(np.asarray(bwd.finish_device()))
+        return np.concatenate(outs, axis=0)
+
+    try:
+        # --- undisturbed reference (clean path: no plan installed) ----
+        assert faults.current() is None
+        t0 = time.time()
+        spill_ref = SpillCache()
+        ref = run_passes(spill_ref)
+        clean_s = time.time() - t0
+
+        # --- the fault schedule --------------------------------------
+        # bwd.feed is called once per group per pass; the kill lands on
+        # pass 2's third group, after two autosaved generations — so the
+        # corrupted newest generation has a good predecessor to fall
+        # back to.
+        kill_at = n_groups + 2
+        if fault_plan is None:
+            fault_plan = FaultPlan(
+                faults=[
+                    {"site": "spill.read", "kind": "ioerror", "at": 1},
+                    {"site": "transfer.d2h", "kind": "ioerror", "at": 1},
+                    {"site": "transfer.h2d", "kind": "ioerror", "at": 2},
+                    {"site": "checkpoint.restore", "kind": "corrupt",
+                     "at": 0},
+                    {"site": "bwd.feed", "kind": "kill", "at": kill_at},
+                ],
+                seed=int(os.environ.get("BENCH_CHAOS_SEED", "20260804")),
+            )
+        degrade.reset()
+        counters0 = dict(
+            (metrics.export().get("counters") or {})
+        ) if metrics.enabled() else {}
+
+        # --- chaos run: fault schedule + kill + resume ---------------
+        t0 = time.time()
+        spill_chaos = SpillCache()
+        resumes = 0
+        got = None
+        with faults.active(fault_plan):
+            try:
+                got = run_passes(spill_chaos, autosave=True)
+            except WorkerKilled as exc:
+                log.warning("chaos drill: %s; resuming from checkpoint",
+                            exc)
+                resumes += 1
+                got = run_passes(
+                    spill_chaos, autosave=True, resume=True
+                )
+        chaos_s = time.time() - t0
+
+        bit_identical = bool(
+            got.shape == ref.shape and np.array_equal(got, ref)
+        )
+        counters = dict(
+            (metrics.export().get("counters") or {})
+        ) if metrics.enabled() else {}
+
+        def delta(name):
+            return counters.get(name, 0) - counters0.get(name, 0)
+
+        pstats = fault_plan.stats()
+        resilience = {
+            "plan": fault_plan.spec(),
+            "faults_injected": pstats["by_site"],
+            "faults_injected_total": pstats["total"],
+            "faults_by_kind": pstats["by_kind"],
+            # the drill finished and verified: every injected fault was
+            # survived (retried past, degraded around, or resumed over)
+            "faults_survived": pstats["total"] if bit_identical else 0,
+            "retries": delta("retry.attempts"),
+            "retries_recovered": delta("retry.recovered"),
+            "degradations": degrade.events(),
+            "resume_count": resumes,
+            "checkpoint_fallbacks": delta("ckpt.fallbacks"),
+            "checkpoint_autosaves": delta("ckpt.autosaves"),
+            "checkpoint_saves": delta("ckpt.saves"),
+            "kill_site": "bwd.feed",
+            "kill_at_call": kill_at,
+            "bit_identical": bit_identical,
+        }
+        return {
+            "metric": f"chaos-drill {config_name}",
+            "value": round(chaos_s, 2),
+            "unit": "s",
+            "config": config_name,
+            "n_subgrids": len(subgrid_configs),
+            "n_groups": n_groups,
+            "n_passes": len(subsets),
+            "clean_run": {
+                "elapsed_s": round(clean_s, 2),
+                "fault_plan_installed": False,
+            },
+            "resilience": resilience,
+            "spill": spill_chaos.stats(),
+        }
+    finally:
+        faults.uninstall()
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def chaos(smoke_mode=False):
+    """`bench.py --chaos [--smoke]`: run the kill-and-resume chaos
+    drill, stamp the resilience artifact, and validate its schema.
+
+    ``--smoke`` runs the 1k drill (the tier-1 wiring via
+    tests/test_bench_smoke.py); the full drill defaults to the 4k
+    config (slow-marked in the tests). ``SWIFTLY_FAULT_PLAN`` replaces
+    the built-in schedule; ``BENCH_CHAOS_CONFIG`` the config.
+    """
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_resilience_artifact,
+    )
+    from swiftly_tpu.resilience import plan_from_env
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    out_path = os.environ.get("BENCH_CHAOS_OUT", "BENCH_chaos.json")
+    metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get(
+        "BENCH_CHAOS_CONFIG",
+        "1k[1]-n512-256" if smoke_mode else "4k[1]-n2k-512",
+    )
+    from swiftly_tpu import SWIFT_CONFIGS
+
+    record = run_chaos_drill(
+        name,
+        fault_plan=plan_from_env(),
+        fold_group=int(os.environ.get("BENCH_CHAOS_FOLD_GROUP", "2")),
+        col_group=int(os.environ.get("BENCH_CHAOS_COL_GROUP", "2")),
+    )
+    record["manifest"] = run_manifest(
+        baseline_source=None, params=dict(SWIFT_CONFIGS[name])
+    )
+    record["telemetry"] = metrics.export()
+    problems = validate_resilience_artifact(record)
+    res = record["resilience"]
+    # the drill's own invariants, beyond the schema: the schedule must
+    # actually have exercised every resilience layer
+    if res["retries"] < 1 or res["retries_recovered"] < 1:
+        problems.append(
+            f"no transient fault was retried+recovered: {res}"
+        )
+    if res["checkpoint_fallbacks"] < 1:
+        problems.append(
+            "the corrupted checkpoint generation was never fallen "
+            f"back from: {res}"
+        )
+    if not any(
+        d["site"] == "checkpoint" for d in res["degradations"]
+    ):
+        problems.append(
+            f"degradation trail missing the checkpoint fallback: "
+            f"{res['degradations']}"
+        )
+    import json as _json
+
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    metrics.disable()
+    print(
+        json.dumps(
+            {
+                "chaos": "ok" if not problems else "failed",
+                "config": name,
+                "artifact": out_path,
+                "bit_identical": res["bit_identical"],
+                "faults_injected": res["faults_injected_total"],
+                "resume_count": res["resume_count"],
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
+
+
 def main():
     import signal
 
@@ -1559,6 +1846,8 @@ def main():
 
     if "--serve" in sys.argv:
         sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
+    if "--chaos" in sys.argv:
+        sys.exit(chaos(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         sys.exit(smoke())
 

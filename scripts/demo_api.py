@@ -37,15 +37,16 @@ def run_streamed_with_checkpoint(
     """The streamed forward->backward loop with optional checkpointing.
 
     Folds each forward column into `bwd`; with `ck_path`, snapshots the
-    backward accumulators every `every` columns (atomic tmp+rename) and,
-    if the file already exists, RESUMES: previously folded columns are
-    skipped (their forward compute is repeated — the forward is
-    stateless — but hours of backward accumulation are not lost).
-    Returns the finished facets. `on_column(items)` is a progress hook
-    (also the kill point of the resume test).
+    backward accumulators every `every` columns (atomic tmp+fsync+rename
+    with per-array CRC32 and keep-N generation rotation — all inside
+    `utils.checkpoint`) and, if the file already exists, RESUMES:
+    previously folded columns are skipped (their forward compute is
+    repeated — the forward is stateless — but hours of backward
+    accumulation are not lost). A corrupt newest generation falls back
+    to the previous good one automatically. Returns the finished
+    facets. `on_column(items)` is a progress hook (also the kill point
+    of the resume test).
     """
-    import os
-
     from swiftly_tpu.utils.checkpoint import (
         restore_streamed_backward_state,
         save_streamed_backward_state,
@@ -74,9 +75,7 @@ def run_streamed_with_checkpoint(
         if on_column is not None:
             on_column(items)
         if ck_path is not None and cols_since_save >= every:
-            tmp = str(ck_path) + ".tmp.npz"
-            save_streamed_backward_state(tmp, bwd, sorted(processed))
-            os.replace(tmp, ck_path)
+            save_streamed_backward_state(ck_path, bwd, sorted(processed))
             cols_since_save = 0
             log.info("checkpoint: %d subgrids folded", len(processed))
     return bwd.finish()

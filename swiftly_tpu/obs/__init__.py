@@ -30,6 +30,7 @@ from .heartbeat import Heartbeat, PartialArtifactWriter
 from .manifest import (
     run_manifest,
     validate_artifact,
+    validate_resilience_artifact,
     validate_serve_artifact,
 )
 
@@ -39,5 +40,6 @@ __all__ = [
     "metrics",
     "run_manifest",
     "validate_artifact",
+    "validate_resilience_artifact",
     "validate_serve_artifact",
 ]

@@ -28,10 +28,12 @@ import time
 __all__ = [
     "BASELINE_SOURCES",
     "MANIFEST_SCHEMA",
+    "RESILIENCE_ARTIFACT_FIELDS",
     "SERVE_ARTIFACT_FIELDS",
     "config_hash",
     "run_manifest",
     "validate_artifact",
+    "validate_resilience_artifact",
     "validate_serve_artifact",
 ]
 
@@ -232,5 +234,68 @@ def validate_serve_artifact(record):
     ):
         problems.append(
             "missing bit_identical {checked, mismatches} block"
+        )
+    return problems
+
+
+# The resilience block every `bench.py --chaos` artifact must carry —
+# the chaos drill's schema contract (what was injected, what survived,
+# how the run degraded, and whether the killed-and-resumed output is
+# bit-identical to the undisturbed run).
+RESILIENCE_ARTIFACT_FIELDS = (
+    "faults_injected",
+    "faults_injected_total",
+    "faults_survived",
+    "retries",
+    "degradations",
+    "resume_count",
+    "bit_identical",
+)
+
+
+def validate_resilience_artifact(record):
+    """Problems with a chaos-mode BENCH artifact, as a list of strings.
+
+    Chaos legs carry no numpy baseline (nothing is being raced) but must
+    carry the full manifest plus a coherent ``resilience`` block: at
+    least one fault injected, every fault survived or resumed past, a
+    resume count >= 1 (the drill kills mid-run by contract), the
+    degradation trail as a list, and ``bit_identical`` True — a chaos
+    drill whose output drifted is a correctness bug, not a resilience
+    result.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    res = record.get("resilience")
+    if not isinstance(res, dict):
+        problems.append("missing resilience block")
+        return problems
+    for field in RESILIENCE_ARTIFACT_FIELDS:
+        if field not in res:
+            problems.append(f"resilience block missing {field!r}")
+    injected = res.get("faults_injected")
+    if injected is not None and not isinstance(injected, dict):
+        problems.append(
+            f"faults_injected is {type(injected).__name__}, expected "
+            "a site -> count dict"
+        )
+    elif isinstance(injected, dict):
+        total = res.get("faults_injected_total")
+        if isinstance(total, int) and total != sum(injected.values()):
+            problems.append(
+                f"faults_injected_total {total} != sum of by-site "
+                f"counts {sum(injected.values())}"
+            )
+    if isinstance(res.get("faults_injected_total"), int):
+        if res["faults_injected_total"] < 1:
+            problems.append("chaos drill injected no faults")
+    if not isinstance(res.get("degradations"), list):
+        problems.append("degradations is not a list")
+    rc = res.get("resume_count")
+    if isinstance(rc, int) and rc < 1:
+        problems.append("resume_count < 1 (the drill must kill+resume)")
+    if res.get("bit_identical") is not True:
+        problems.append(
+            f"bit_identical is {res.get('bit_identical')!r}, the "
+            "resumed run must match the undisturbed run exactly"
         )
     return problems

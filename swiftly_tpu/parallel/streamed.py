@@ -137,6 +137,9 @@ except ImportError:  # pragma: no cover - older jax
 from .mesh import FACET_AXIS, mesh_size as _mesh_size, varying  # noqa: E402
 
 from ..obs import metrics as _metrics  # noqa: E402
+from ..resilience import degrade as _degrade  # noqa: E402
+from ..resilience.faults import fault_point as _fault_point  # noqa: E402
+from ..resilience.retry import retry_transient as _retry  # noqa: E402
 
 
 def _scoped(name, fn):
@@ -2394,8 +2397,38 @@ class StreamedForward:
                 )
             if _metrics.enabled():
                 _metrics.count("spill.replay_feeds")
-            yield from self._replay_spilled_groups(spill)
-            return
+            n_yielded = 0
+            try:
+                for item in self._replay_spilled_groups(spill):
+                    yield item
+                    n_yielded += 1
+                return
+            except OSError as exc:
+                # degradation ladder: a cached group stayed unreadable
+                # past its retries mid-feed — fall back to replaying the
+                # forward and resume the stream at the exact group the
+                # cache failed on (groups stream in deterministic
+                # order). Costs one forward pass; never a wrong answer.
+                logger.warning(
+                    "spill cache read failed at group %d (%s: %s); "
+                    "replaying the forward for the rest of this pass",
+                    n_yielded, type(exc).__name__, exc,
+                )
+                _degrade.record(
+                    "spill", "replay_fallback",
+                    f"group {n_yielded}: {type(exc).__name__}: {exc}",
+                )
+                spill.gave_up = True
+                spill.complete = False
+                if _metrics.enabled():
+                    _metrics.count("spill.fallback_replays")
+                    _metrics.count("fwd.passes")
+                for k, item in enumerate(
+                    self._sampled_generator(groups, size, whole_groups=True)
+                ):
+                    if k >= n_yielded:
+                        yield item
+                return
         if spill is not None and spill.gave_up:
             # a previous fill overflowed the budget: re-recording would
             # overflow again — replay the forward without the d2h cost
@@ -2438,9 +2471,15 @@ class StreamedForward:
         """Copy one yielded group's stack to the cache (d2h + put)."""
         if spill.gave_up:
             return  # an earlier eviction voided the fill: skip the d2h
-        with _metrics.stage("spill.write") as st:
-            host = np.asarray(out_g)
-            st.bytes_moved = int(host.nbytes)
+
+        def pull():
+            _fault_point("transfer.d2h")
+            with _metrics.stage("spill.write") as st:
+                arr = np.asarray(out_g)
+                st.bytes_moved = int(arr.nbytes)
+            return arr
+
+        host = _retry(pull, site="transfer.d2h")
         if spill.put(per_col, host) and _metrics.enabled():
             _metrics.count("spill.writes")
             _metrics.count("spill.bytes_written", int(host.nbytes))
@@ -2456,9 +2495,15 @@ class StreamedForward:
             with _metrics.stage("spill.read") as st:
                 host = spill.get(k)
                 st.bytes_moved = int(host.nbytes)
-            with _metrics.stage("spill.h2d") as st:
-                dev = jnp.asarray(host)
-                st.bytes_moved = int(host.nbytes)
+
+            def upload():
+                _fault_point("transfer.h2d")
+                with _metrics.stage("spill.h2d") as st:
+                    arr = jnp.asarray(host)
+                    st.bytes_moved = int(host.nbytes)
+                return arr
+
+            dev = _retry(upload, site="transfer.h2d")
             if _metrics.enabled():
                 _metrics.count("spill.prefetch_hits")
             if pending is not None:
@@ -2495,10 +2540,14 @@ class StreamedForward:
             yield from gen
             return
         def pull(arr):
-            with _metrics.stage("fwd.d2h") as st:
-                host = np.asarray(arr)
-                st.bytes_moved = int(host.nbytes)
-            return host
+            def once():
+                _fault_point("transfer.d2h")
+                with _metrics.stage("fwd.d2h") as st:
+                    host = np.asarray(arr)
+                    st.bytes_moved = int(host.nbytes)
+                return host
+
+            return _retry(once, site="transfer.d2h")
 
         pending = []
         for items, out in gen:
@@ -3336,6 +3385,51 @@ class StreamedBackward:
         # (the BENCH_r04 32k roundtrip OOM ledger gap).
         self._rows_inflight = collections.deque()
         self._finished = False
+        # (off0, off1) of every folded subgrid — the resume ledger the
+        # autosave snapshots and `restore_streamed_backward_state`
+        # repopulates, so a resumed feed loop knows what to skip
+        self.processed = []
+        self._autosave = None
+
+    def enable_autosave(self, path, every_subgrids=0, every_s=0.0):
+        """Periodic checkpointing driven by the feed itself: snapshot to
+        `path` (atomic, checksummed, keep-N rotated — `utils.checkpoint`)
+        every `every_subgrids` folded subgrids and/or every `every_s`
+        seconds of wall clock, whichever fires first. The snapshot
+        carries this session's ``processed`` ledger, so a killed run
+        resumes via `restore_streamed_backward_state` + skipping the
+        processed keys. Zero overhead beyond a counter until a save is
+        due. Pass neither to disable."""
+        every_subgrids = int(every_subgrids)
+        every_s = float(every_s)
+        if every_subgrids <= 0 and every_s <= 0:
+            self._autosave = None
+            return
+        self._autosave = {
+            "path": str(path),
+            "every_n": every_subgrids,
+            "every_s": every_s,
+            "since": 0,
+            "last_t": time.monotonic(),
+        }
+
+    def _autosave_tick(self, n_folded):
+        a = self._autosave
+        if a is None:
+            return
+        a["since"] += n_folded
+        now = time.monotonic()
+        due = (a["every_n"] > 0 and a["since"] >= a["every_n"]) or (
+            a["every_s"] > 0 and now - a["last_t"] >= a["every_s"]
+        )
+        if not due:
+            return
+        from ..utils.checkpoint import save_streamed_backward_state
+
+        save_streamed_backward_state(a["path"], self, self.processed)
+        a["since"] = 0
+        a["last_t"] = time.monotonic()
+        _metrics.count("ckpt.autosaves")
 
     def _bwd_cp_flops(self, n_subgrids, subgrid_size):
         """Analytic FLOPs of one backward column pass over `n_subgrids`
@@ -3377,6 +3471,7 @@ class StreamedBackward:
 
         if self._finished:
             raise RuntimeError("finish() was already called")
+        _fault_point("bwd.feed")
         base = self._base
         core = base.core
         off0s = {sg.off0 for sg in sg_configs}
@@ -3432,6 +3527,10 @@ class StreamedBackward:
             self._pending_rows.append((key, rows))
             if len(self._pending_rows) >= self._fold_group:
                 self._flush_folds()
+            self.processed.extend(
+                (sg.off0, sg.off1) for sg in sg_configs
+            )
+            self._autosave_tick(len(sg_configs))
             return
         pad = base._yB_pad - yB
         if pad:
@@ -3447,6 +3546,8 @@ class StreamedBackward:
                 self._naf[key] += np.asarray(rows)
             else:
                 self._naf[key] = np.array(rows)  # writable copy
+        self.processed.extend((sg.off0, sg.off1) for sg in sg_configs)
+        self._autosave_tick(len(sg_configs))
 
     def _ensure_acc(self):
         import jax.numpy as jnp
@@ -3607,6 +3708,7 @@ class StreamedBackward:
             raise ValueError(
                 "add_subgrid_group requires residency='sampled'"
             )
+        _fault_point("bwd.feed")
         base = self._base
         if base.mesh is not None:
             # per-column sharded path (the group-batched column pass is
@@ -3673,6 +3775,16 @@ class StreamedBackward:
                 + rows.shape[3:]
             )  # [F, g*m, yB(,2)]
             self._fold_rows(offs[j : j + cap], rows_cat)
+        # the whole group folded: ledger + autosave AT GROUP BOUNDARIES
+        # only — the processed set then always covers whole groups, so a
+        # resumed feed loop skips group-by-group and fold batching (per
+        # cap chunk within each group) is identical before and after a
+        # kill (the chaos drill's bit-identity rests on this)
+        n_group = 0
+        for col in col_sg_lists:
+            self.processed.extend((sg.off0, sg.off1) for sg in col)
+            n_group += len(col)
+        self._autosave_tick(n_group)
 
     def finish_device(self):
         """("sampled") the finished facet stack [F_total, yB, yB(,2)] as a

@@ -1,0 +1,54 @@
+"""Resilient execution: fault injection, retry/backoff, degradation.
+
+The paper's workload is multi-hour streamed transforms that never
+materialise the full grid; the ROADMAP's is production serving. Both
+treat worker and I/O failure as an expected event (DaggerFFT,
+arXiv 2601.12209; TPU-scale linear algebra, arXiv 2112.09017). This
+package is the discipline layer:
+
+* ``resilience.faults``  — deterministic, seedable `FaultPlan` hooking
+  named engine sites (spill I/O, transfers, checkpoint save/restore,
+  serve dispatch); zero-cost no-op when no plan is installed.
+* ``resilience.retry``   — the shared `retry_transient` wrapper:
+  transient-vs-fatal classification + jittered exponential backoff,
+  accounted via `obs.metrics` (``retry.*`` counters).
+* ``resilience.degrade`` — the graceful-degradation ledger every
+  ladder step (spill disk -> RAM -> replay; corrupt checkpoint ->
+  previous generation; fused batch -> split -> per-request) records
+  into, stamped into chaos artifacts.
+
+Hardened checkpointing (atomic tmp+fsync+rename writes, per-array
+CRC32, keep-N generation rotation with automatic fallback) lives in
+`utils.checkpoint`; the chaos drill that exercises all of it is
+``bench.py --chaos`` / scripts/chaos_drill.py. See docs/resilience.md.
+"""
+
+from . import degrade
+from .faults import (
+    FaultError,
+    FaultPlan,
+    InjectedResourceExhausted,
+    WorkerKilled,
+    active,
+    fault_point,
+    install,
+    plan_from_env,
+    uninstall,
+)
+from .retry import backoff_delay, is_transient, retry_transient
+
+__all__ = [
+    "FaultError",
+    "FaultPlan",
+    "InjectedResourceExhausted",
+    "WorkerKilled",
+    "active",
+    "backoff_delay",
+    "degrade",
+    "fault_point",
+    "install",
+    "is_transient",
+    "plan_from_env",
+    "retry_transient",
+    "uninstall",
+]

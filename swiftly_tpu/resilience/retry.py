@@ -1,0 +1,125 @@
+"""Shared transient-failure retry: jittered exponential backoff plus
+transient-vs-fatal classification.
+
+Before this module each subsystem had its own failure posture: a flaky
+spill-disk read killed a multi-hour run, serve retried instantly with
+no backoff (thundering-herd on a struggling device), and transfers had
+no retry at all. `retry_transient` is the one wrapper all of them use:
+
+* **Classification first.** Only transiently-classified errors retry
+  (`is_transient`): OS-level I/O errors, timeouts, and runtime errors
+  whose text carries the runtime's transient status codes
+  (``RESOURCE_EXHAUSTED``, ``UNAVAILABLE``, ...). Deterministic errors
+  (a shape mismatch, a config error) re-raise immediately — retrying
+  them only delays the real diagnosis. `faults.WorkerKilled` is a
+  ``BaseException`` and never enters the handler at all.
+* **Jittered exponential backoff.** Delay ``min(max_s, base_s * 2^k)``
+  scaled by a uniform [0.5, 1.0) jitter — synchronized retry storms
+  from parallel workers decorrelate.
+* **Accounted.** ``retry.attempts`` / ``retry.attempts.<site>`` count
+  every retry, ``retry.recovered`` the calls that succeeded after one,
+  ``retry.exhausted`` the ones that ran out of attempts (via
+  `obs.metrics`, zero-cost when disabled).
+
+``SWIFTLY_RETRY_MAX`` (default 3) caps retry attempts process-wide.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+
+from ..obs import metrics as _metrics
+
+__all__ = [
+    "TRANSIENT_MARKERS",
+    "backoff_delay",
+    "is_transient",
+    "max_retry_attempts",
+    "retry_transient",
+]
+
+# Runtime status codes that mark a failure worth retrying when they
+# appear in an exception's text (XLA/PJRT surface these as RuntimeError
+# strings, not typed exceptions).
+TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "CANCELLED",
+    "temporarily unavailable",
+)
+
+_rng = random.Random()
+
+
+def max_retry_attempts(default=3):
+    """Process-wide retry cap (``SWIFTLY_RETRY_MAX``, default 3)."""
+    try:
+        return max(0, int(os.environ.get("SWIFTLY_RETRY_MAX", default)))
+    except ValueError:
+        return default
+
+
+def is_transient(exc) -> bool:
+    """Worth retrying? OS-level I/O failures and timeouts are; anything
+    whose message carries a transient runtime status code is; other
+    (deterministic) errors are not."""
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
+        return True
+    text = f"{type(exc).__name__}: {exc}"
+    return any(marker in text for marker in TRANSIENT_MARKERS)
+
+
+def backoff_delay(attempt, base_s=0.05, max_s=2.0, rng=None):
+    """Jittered exponential delay for retry number `attempt` (0-based)."""
+    r = (rng or _rng).random()
+    return min(max_s, base_s * (2.0 ** attempt)) * (0.5 + 0.5 * r)
+
+
+def retry_transient(fn, site="", max_attempts=None, base_s=0.05,
+                    max_s=2.0, classify=is_transient, sleep=time.sleep,
+                    rng=None, on_retry=None):
+    """Call ``fn()``; retry transiently-classified failures with jittered
+    exponential backoff. Returns ``fn()``'s value or re-raises the last
+    error (fatal errors re-raise immediately, unretried).
+
+    :param site: metrics label (``retry.attempts.<site>``)
+    :param max_attempts: retry cap (default ``SWIFTLY_RETRY_MAX``)
+    :param classify: predicate deciding retryability (`is_transient`)
+    :param sleep: injectable for tests (receives the delay in seconds)
+    :param on_retry: optional ``fn(attempt, exc, delay_s)`` observer
+    """
+    attempts = (
+        max_retry_attempts() if max_attempts is None else int(max_attempts)
+    )
+    for attempt in range(attempts + 1):
+        try:
+            out = fn()
+        except Exception as exc:
+            if not classify(exc):
+                raise
+            if attempt >= attempts:
+                _metrics.count("retry.exhausted")
+                if site:
+                    _metrics.count(f"retry.exhausted.{site}")
+                raise
+            _metrics.count("retry.attempts")
+            if site:
+                _metrics.count(f"retry.attempts.{site}")
+            delay = backoff_delay(attempt, base_s, max_s, rng)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            _metrics.event("retry", site=site, attempt=attempt,
+                           error=f"{type(exc).__name__}: {exc}",
+                           delay_s=round(delay, 4))
+            sleep(delay)
+        else:
+            if attempt:
+                _metrics.count("retry.recovered")
+                if site:
+                    _metrics.count(f"retry.recovered.{site}")
+            return out
+    raise AssertionError("unreachable")  # pragma: no cover

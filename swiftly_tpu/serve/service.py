@@ -54,6 +54,9 @@ import time
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..resilience import degrade as _degrade
+from ..resilience.faults import fault_point as _fault_point
+from ..resilience.retry import backoff_delay as _backoff_delay
 from .queue import (
     STATUS_EXPIRED,
     STATUS_OK,
@@ -102,6 +105,11 @@ def _quantile(sorted_samples, q):
     return sorted_samples[i]
 
 
+def _is_oom(exc) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
+
+
 class SubgridService:
     """Serve individual subgrid requests through a shared forward.
 
@@ -120,6 +128,11 @@ class SubgridService:
         submit (min'd with the request's own ``deadline_s``)
     :param max_retries: single-request retry attempts after a batch
         failure before quarantine
+    :param retry_backoff_s: base of the capped jittered exponential
+        backoff between single-request retries (cap 16x the base; 0
+        disables). Instant retries against a struggling device are a
+        thundering herd — the backoff decorrelates them, and the total
+        slept is reported as ``retry_backoff_s`` in ``stats()``.
     :param fuse_columns: columns per dispatch; > 1 uses the fused
         whole-cover program (`all_subgrids`) over several columns
     :param slo_ms: latency SLO — served requests slower than this are
@@ -131,8 +144,8 @@ class SubgridService:
     """
 
     def __init__(self, fwd, queue=None, scheduler=None, cache_feed=None,
-                 timeout_s=None, max_retries=2, fuse_columns=1,
-                 slo_ms=None, fault_injector=None,
+                 timeout_s=None, max_retries=2, retry_backoff_s=0.005,
+                 fuse_columns=1, slo_ms=None, fault_injector=None,
                  hbm_budget_bytes=None, max_depth=256):
         self.fwd = fwd
         if queue is None:
@@ -147,15 +160,17 @@ class SubgridService:
         self.cache_feed = cache_feed
         self.timeout_s = timeout_s
         self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         self.fuse_columns = int(fuse_columns)
         self.slo_ms = slo_ms
         self.fault_injector = fault_injector
         self.quarantined = []  # [(request, error_repr), ...]
+        self._backoff_slept_s = 0.0
         self._counts = {
             "requests": 0, "served": 0, "shed": 0, "expired": 0,
             "quarantined": 0, "retries": 0, "batches": 0,
-            "batch_failures": 0, "coalesced": 0, "cache_hits": 0,
-            "cache_fallbacks": 0, "slo_violations": 0,
+            "batch_failures": 0, "batch_splits": 0, "coalesced": 0,
+            "cache_hits": 0, "cache_fallbacks": 0, "slo_violations": 0,
         }
         self._shed_reasons = {}
         self._latencies = []
@@ -289,12 +304,15 @@ class SubgridService:
             )
         return remaining
 
-    def _execute(self, requests):
+    def _execute(self, requests, _split_depth=0):
         """One coalesced dispatch for the taken requests, with
-        batch-failure isolation."""
+        batch-failure isolation. A fused-batch OOM first steps down the
+        degradation ladder — split the batch in half and dispatch each
+        half (smaller transients) — before per-request isolation."""
         self._counts["batches"] += 1
         _metrics.count("serve.batches")
         try:
+            _fault_point("serve.dispatch")
             if self.fault_injector is not None:
                 self.fault_injector(requests, 0)
             with _metrics.stage("serve.batch"):
@@ -310,6 +328,21 @@ class SubgridService:
         except Exception as exc:
             self._counts["batch_failures"] += 1
             _metrics.count("serve.batch_failures")
+            if _is_oom(exc) and len(requests) > 1 and _split_depth < 4:
+                self._counts["batch_splits"] += 1
+                _metrics.count("serve.batch_splits")
+                _degrade.record(
+                    "serve", "batch_split",
+                    f"{len(requests)} requests OOM'd; splitting",
+                )
+                log.warning(
+                    "coalesced batch of %d OOM'd (%s); splitting in half",
+                    len(requests), type(exc).__name__,
+                )
+                mid = len(requests) // 2
+                self._execute(requests[:mid], _split_depth + 1)
+                self._execute(requests[mid:], _split_depth + 1)
+                return
             log.warning(
                 "coalesced batch of %d failed (%s: %s); isolating",
                 len(requests), type(exc).__name__, exc,
@@ -337,6 +370,16 @@ class SubgridService:
             last_err = batch_exc
             served = False
             for attempt in range(1, self.max_retries + 1):
+                if self.retry_backoff_s > 0:
+                    # capped jittered exponential backoff: retrying
+                    # instantly against a struggling device synchronises
+                    # the herd; the slept total is reported in stats()
+                    delay = _backoff_delay(
+                        attempt - 1, base_s=self.retry_backoff_s,
+                        max_s=16 * self.retry_backoff_s,
+                    )
+                    self._backoff_slept_s += delay
+                    time.sleep(delay)
                 req.retries += 1
                 self._counts["retries"] += 1
                 _metrics.count("serve.retries")
@@ -452,7 +495,9 @@ class SubgridService:
             "n_quarantined": c["quarantined"],
             "n_batches": c["batches"],
             "batch_failures": c["batch_failures"],
+            "batch_splits": c["batch_splits"],
             "retries": c["retries"],
+            "retry_backoff_s": round(self._backoff_slept_s, 4),
             "cache_hits": c["cache_hits"],
             "cache_fallbacks": c["cache_fallbacks"],
             "shed_rate": round(c["shed"] / requests, 4) if requests else 0.0,

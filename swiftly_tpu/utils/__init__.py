@@ -3,10 +3,13 @@ compilation cache, subgrid-stream spill cache."""
 
 from .cache import enable_compilation_cache
 from .checkpoint import (
+    CorruptCheckpointError,
+    checkpoint_generations,
     restore_backward_state,
     restore_streamed_backward_state,
     save_backward_state,
     save_streamed_backward_state,
+    verify_checkpoint,
 )
 from .flops import (
     backward_batched_flops,
@@ -31,8 +34,10 @@ from .profiling import (
 )
 
 __all__ = [
+    "CorruptCheckpointError",
     "MemorySampler",
     "backward_batched_flops",
+    "checkpoint_generations",
     "backward_sampled_flops",
     "bwd_column_pass_flops",
     "bwd_fold_flops",
@@ -54,4 +59,5 @@ __all__ = [
     "SpillCache",
     "spill_budget_bytes",
     "trace",
+    "verify_checkpoint",
 ]

@@ -1,4 +1,4 @@
-"""Checkpoint/resume for streaming transforms.
+"""Checkpoint/resume for streaming transforms — hardened.
 
 A `SwiftlyBackward` session is a long-running accumulation (hours at 64k
 scale); its state is exactly (a) the per-facet accumulators, (b) the live
@@ -9,26 +9,248 @@ killed run resumes without recomputing finished subgrids.
 (The reference has no checkpointing — its docs mention removed HDF5
 subgrid dumps; this implements the "streaming accumulators as checkpoint
 units" design its architecture implies.)
+
+Durability discipline (the resilience layer's contract,
+docs/resilience.md):
+
+* **Atomic writes.** Every snapshot lands via tmp + ``fsync`` +
+  ``os.replace`` — a crash mid-save can truncate only the tmp file,
+  never the live checkpoint (the pre-hardening failure mode: a crash
+  inside ``np.savez`` left a torn ``.npz`` that poisoned the resume).
+* **Per-array CRC32.** Each array's checksum is stored in the snapshot
+  meta and verified on restore; silent disk corruption raises
+  :class:`CorruptCheckpointError` instead of folding garbage.
+* **Keep-N generations.** Saves rotate ``path`` -> ``path.1`` ->
+  ``path.2`` ... (``SWIFTLY_CKPT_KEEP`` total, default 3); restore
+  falls back generation by generation past corrupt/truncated snapshots
+  (counted as ``ckpt.fallbacks`` and recorded in the degradation
+  ledger), so one bad write costs a few columns of recompute, not the
+  run.
+* **Fault sites.** ``checkpoint.save`` / ``checkpoint.save.done`` /
+  ``checkpoint.restore`` are `resilience.faults` hook points — the
+  chaos drill corrupts and kills here on a schedule.
+
+Config-mismatch errors (wrong params/backend/kind/version) are
+deliberately NOT retried against older generations: every generation
+was written by the same session, so a mismatch is a caller bug and
+must surface loudly.
 """
 
 from __future__ import annotations
 
 import json
+import logging
+import os
+import zlib
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..resilience import degrade as _degrade
+from ..resilience.faults import fault_point
+
 __all__ = [
-    "save_backward_state",
+    "CorruptCheckpointError",
+    "checkpoint_generations",
+    "ckpt_keep",
     "restore_backward_state",
-    "save_streamed_backward_state",
     "restore_streamed_backward_state",
+    "save_backward_state",
+    "save_streamed_backward_state",
+    "verify_checkpoint",
 ]
 
-_VERSION = 1
+logger = logging.getLogger(__name__)
+
+# v2 adds per-array CRC32 checksums to the meta; v1 snapshots (no
+# checksums) still restore — integrity verification is skipped for them.
+_VERSION = 2
+_SUPPORTED_VERSIONS = (1, 2)
+
+
+class CorruptCheckpointError(ValueError):
+    """The snapshot file is unreadable or fails integrity verification
+    (truncated archive, bad CRC, undecodable meta). Restore treats this
+    as a damaged *generation* and falls back; config mismatches raise
+    plain ``ValueError`` and do not."""
+
+
+def ckpt_keep(default=3):
+    """Total checkpoint generations kept (``SWIFTLY_CKPT_KEEP``, >= 1)."""
+    try:
+        return max(1, int(os.environ.get("SWIFTLY_CKPT_KEEP", default)))
+    except ValueError:
+        return default
+
+
+def checkpoint_generations(path):
+    """Existing generation files for `path`, newest first."""
+    path = str(path)
+    out = [path] if os.path.exists(path) else []
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        out.append(f"{path}.{k}")
+        k += 1
+    return out
+
+
+def _crc(arr) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).view(np.uint8).data)
+
+
+def _rotate(path, keep):
+    """Shift path -> path.1 -> ... -> path.(keep-1); the oldest drops."""
+    if keep <= 1 or not os.path.exists(path):
+        return
+    for k in range(keep - 1, 0, -1):
+        src = path if k == 1 else f"{path}.{k - 1}"
+        dst = f"{path}.{k}"
+        if os.path.exists(src):
+            os.replace(src, dst)
+
+
+def _atomic_savez(path, arrays, meta):
+    """Checksummed meta + atomic tmp/fsync/rename write + rotation."""
+    path = str(path)
+    fault_point("checkpoint.save", path)
+    meta = dict(meta)
+    meta["crc"] = {name: _crc(arr) for name, arr in arrays.items()}
+    meta_bytes = json.dumps(meta).encode()
+    arrays["meta"] = np.frombuffer(meta_bytes, dtype=np.uint8)
+    # the meta's own integrity: a bit-flip inside the JSON could parse
+    # to a silently different session description
+    arrays["meta_crc"] = np.asarray(
+        [zlib.crc32(meta_bytes)], dtype=np.uint32
+    )
+    tmp = path + ".tmp"
+    with _metrics.stage("ckpt.save") as st:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, **arrays)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _rotate(path, ckpt_keep())
+        os.replace(tmp, path)
+        st.bytes_moved = int(os.path.getsize(path))
+    _metrics.count("ckpt.saves")
+    # post-landing hook: a "corrupt" fault flips a byte in the final
+    # file — the generation the next restore must detect and skip
+    fault_point("checkpoint.save.done", path)
+
+
+def _open_verified(path):
+    """np.load the snapshot and parse+verify its meta; any structural
+    failure (torn zip, undecodable meta) -> CorruptCheckpointError."""
+    try:
+        data = np.load(path)
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} unreadable: {type(exc).__name__}: {exc}"
+        ) from exc
+    try:
+        meta_bytes = bytes(data["meta"].tobytes())
+        if "meta_crc" in data.files:
+            want = int(data["meta_crc"][0])
+            got = zlib.crc32(meta_bytes)
+            if got != want:
+                raise CorruptCheckpointError(
+                    f"checkpoint {path!r} meta failed CRC32 "
+                    f"verification (stored {want}, got {got})"
+                )
+        meta = json.loads(meta_bytes.decode())
+    except CorruptCheckpointError:
+        data.close()
+        raise
+    except Exception as exc:
+        data.close()
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} meta undecodable: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+    return data, meta
+
+
+def _load_array(data, meta, name, path):
+    """One array out of the snapshot, CRC-verified when the snapshot
+    carries checksums (v2+)."""
+    try:
+        arr = data[name]
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} array {name!r} unreadable: "
+            f"{type(exc).__name__}: {exc}"
+        ) from exc
+    want = (meta.get("crc") or {}).get(name)
+    if want is not None and _crc(arr) != want:
+        raise CorruptCheckpointError(
+            f"checkpoint {path!r} array {name!r} failed CRC32 "
+            f"verification (stored {want}, got {_crc(arr)})"
+        )
+    return arr
+
+
+def verify_checkpoint(path):
+    """Integrity problems with the snapshot at `path` (empty = good).
+
+    Reads every array and checks its CRC — the offline twin of what
+    restore does, for drills and operators (``python -c`` one-liner in
+    docs/resilience.md)."""
+    problems = []
+    try:
+        data, meta = _open_verified(str(path))
+    except CorruptCheckpointError as exc:
+        return [str(exc)]
+    with data:
+        if meta.get("version") not in _SUPPORTED_VERSIONS:
+            problems.append(f"unsupported version {meta.get('version')!r}")
+        if meta.get("version", 0) >= 2 and "crc" not in meta:
+            problems.append("v2 snapshot missing crc table")
+        for name in data.files:
+            if name == "meta":
+                continue
+            try:
+                _load_array(data, meta, name, str(path))
+            except CorruptCheckpointError as exc:
+                problems.append(str(exc))
+    return problems
+
+
+def _restore_with_fallback(path, restore_one):
+    """Run `restore_one(generation)` against path, then older
+    generations, skipping corrupt snapshots (counted + recorded)."""
+    gens = checkpoint_generations(path)
+    if not gens:
+        raise FileNotFoundError(f"no checkpoint at {path!r}")
+    last_exc = None
+    for k, gen in enumerate(gens):
+        try:
+            fault_point("checkpoint.restore", gen)
+            with _metrics.stage("ckpt.restore"):
+                out = restore_one(gen)
+            if k:
+                _metrics.count("ckpt.fallbacks", k)
+                _degrade.record(
+                    "checkpoint", "fallback_generation",
+                    f"{path!r} generations 0..{k - 1} corrupt; "
+                    f"restored {gen!r}",
+                )
+                logger.warning(
+                    "checkpoint %r corrupt; restored previous "
+                    "generation %r", path, gen,
+                )
+            return out
+        except CorruptCheckpointError as exc:
+            last_exc = exc
+            logger.warning("checkpoint generation %r: %s", gen, exc)
+            continue
+    raise CorruptCheckpointError(
+        f"all {len(gens)} checkpoint generation(s) of {path!r} are "
+        f"corrupt (last: {last_exc})"
+    ) from last_exc
 
 
 def save_backward_state(path, backward, processed_subgrids=None):
-    """Snapshot a SwiftlyBackward session to `path` (.npz).
+    """Snapshot a SwiftlyBackward session to `path` (.npz): atomic,
+    checksummed, keep-N rotated.
 
     :param backward: the SwiftlyBackward instance
     :param processed_subgrids: optional list of (off0, off1) already folded
@@ -52,20 +274,24 @@ def save_backward_state(path, backward, processed_subgrids=None):
     for key, col in backward.lru._store.items():
         meta["lru_keys"].append(int(key))
         arrays[f"lru_{int(key)}"] = np.asarray(col)
-    arrays["meta"] = np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8
-    )
-    np.savez(path, **arrays)
+    _atomic_savez(path, arrays, meta)
 
 
 def restore_backward_state(path, backward):
     """Restore a snapshot into a freshly constructed SwiftlyBackward.
 
     The instance must be built with the same config/facet list as the one
-    saved. Returns the list of (off0, off1) subgrids already processed.
+    saved. Corrupt generations fall back to the previous good one.
+    Returns the list of (off0, off1) subgrids already processed.
     """
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+    return _restore_with_fallback(
+        path, lambda gen: _restore_backward_one(gen, backward)
+    )
+
+
+def _restore_backward_one(path, backward):
+    data, meta = _open_verified(path)
+    with data:
         core = backward.core
         _check_meta(meta, core, backward.stack.n_total, "backward")
 
@@ -89,14 +315,18 @@ def restore_backward_state(path, backward):
             return arr
 
         if meta["has_mnaf"]:
-            backward._MNAF_BMNAFs = _dev(data["MNAF_BMNAFs"])
+            backward._MNAF_BMNAFs = _dev(
+                _load_array(data, meta, "MNAF_BMNAFs", path)
+            )
         for key in meta["lru_keys"]:
-            backward.lru.set(key, _dev(data[f"lru_{key}"]))
+            backward.lru.set(
+                key, _dev(_load_array(data, meta, f"lru_{key}", path))
+            )
         return [tuple(p) for p in meta["processed"]]
 
 
 def _check_meta(meta, core, n_total, kind):
-    if meta["version"] != _VERSION:
+    if meta["version"] not in _SUPPORTED_VERSIONS:
         raise ValueError(f"Unsupported checkpoint version {meta['version']}")
     # legacy files (written by save_backward_state before "kind" existed)
     # default to "backward" so a cross-kind restore fails loudly here
@@ -116,7 +346,8 @@ def _check_meta(meta, core, n_total, kind):
 
 
 def save_streamed_backward_state(path, backward, processed_subgrids=None):
-    """Snapshot a StreamedBackward session to `path` (.npz).
+    """Snapshot a StreamedBackward session to `path` (.npz): atomic,
+    checksummed, keep-N rotated.
 
     The streamed backward's whole state is its per-column NAF_BMNAF row
     accumulators (`_naf`, one [F, m, yB_pad] array per seen column) —
@@ -125,9 +356,12 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
 
     :param backward: the StreamedBackward instance
     :param processed_subgrids: optional list of (off0, off1) already folded
-        in, stored for the caller to skip on resume
+        in, stored for the caller to skip on resume; defaults to the
+        backward's own ``processed`` ledger when it has one
     """
     core = backward.core
+    if processed_subgrids is None:
+        processed_subgrids = getattr(backward, "processed", None)
     arrays = {}
     meta = {
         "version": _VERSION,
@@ -153,21 +387,26 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
     for key, rows in backward._naf.items():
         meta["naf_keys"].append(int(key))
         arrays[f"naf_{int(key)}"] = np.asarray(rows)
-    arrays["meta"] = np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8
-    )
-    np.savez(path, **arrays)
+    _atomic_savez(path, arrays, meta)
 
 
 def restore_streamed_backward_state(path, backward):
     """Restore a snapshot into a freshly constructed StreamedBackward.
 
     The instance must be built with the same config/facet list (and may
-    use either residency — accumulators are re-placed to match). Returns
-    the list of (off0, off1) subgrids already processed.
+    use either residency — accumulators are re-placed to match). Corrupt
+    generations fall back to the previous good one. Returns the list of
+    (off0, off1) subgrids already processed (also assigned to
+    ``backward.processed``).
     """
-    with np.load(path) as data:
-        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+    return _restore_with_fallback(
+        path, lambda gen: _restore_streamed_one(gen, backward)
+    )
+
+
+def _restore_streamed_one(path, backward):
+    data, meta = _open_verified(path)
+    with data:
         core = backward.core
         _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
         saved_res = meta.get("residency")
@@ -178,6 +417,7 @@ def restore_streamed_backward_state(path, backward):
                 f"session uses {backward._base.residency!r} (the sampled "
                 f"accumulator and NAF rows are not interchangeable)"
             )
+        processed = [tuple(p) for p in meta["processed"]]
         if is_sampled:
             saved_slab = meta.get("row_slab")
             have_slab = getattr(backward, "_row_slab", None)
@@ -192,14 +432,19 @@ def restore_streamed_backward_state(path, backward):
                     f"{list(have_slab) if have_slab else None}"
                 )
             if meta.get("has_acc"):
-                backward._acc = backward._base._place(data["acc"])
-            return [tuple(p) for p in meta["processed"]]
-        # older snapshots (same _VERSION) did not record yB_pad; the rows
-        # arrays carry it as their last data axis either way
+                backward._acc = backward._base._place(
+                    _load_array(data, meta, "acc", path)
+                )
+            backward.processed = list(processed)
+            return processed
+        # older snapshots (same meta layout) did not record yB_pad; the
+        # rows arrays carry it as their last data axis either way
         saved_pad = meta.get("yB_pad")
         if saved_pad is None and meta["naf_keys"]:
             # rows are [F, m, yB_pad] (+ trailing planar pair axis)
-            saved_pad = data[f"naf_{meta['naf_keys'][0]}"].shape[2]
+            saved_pad = _load_array(
+                data, meta, f"naf_{meta['naf_keys'][0]}", path
+            ).shape[2]
         if saved_pad is not None and saved_pad != backward._base._yB_pad:
             # rows are stored at the saving session's col_block padding;
             # a different padding would make finish() slice garbage
@@ -212,10 +457,11 @@ def restore_streamed_backward_state(path, backward):
 
         device = backward._base.residency == "device"
         for key in meta["naf_keys"]:
-            rows = data[f"naf_{key}"]
+            rows = _load_array(data, meta, f"naf_{key}", path)
             if device:
                 # facet-sharded on a mesh, plain device array otherwise
                 backward._naf[key] = backward._base._place(rows)
             else:
                 backward._naf[key] = np.array(rows)
-        return [tuple(p) for p in meta["processed"]]
+        backward.processed = list(processed)
+        return processed

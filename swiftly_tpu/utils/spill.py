@@ -36,6 +36,7 @@ the streamed executor against ``obs.metrics``.
 
 from __future__ import annotations
 
+import glob
 import logging
 import os
 import shutil
@@ -44,6 +45,9 @@ import tempfile
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..resilience import degrade as _degrade
+from ..resilience.faults import fault_point
+from ..resilience.retry import retry_transient
 
 __all__ = ["SpillCache", "spill_budget_bytes"]
 
@@ -114,10 +118,12 @@ class SpillCache:
     # -- fill ---------------------------------------------------------------
 
     def begin_fill(self, tag=None):
-        """Start (re)recording a stream; drops any previous entries.
+        """Start (re)recording a stream; drops any previous entries and
+        sweeps orphaned ``.tmp`` files a crashed fill may have left.
         ``tag`` identifies the stream (e.g. the cover's shape) so a
         consumer can refuse a cache recorded for different inputs."""
         self._clear_entries()
+        self._sweep_orphans()
         self.complete = False
         self.gave_up = False
         self.tag = tag
@@ -136,7 +142,29 @@ class SpillCache:
             self._entries.append(("ram", array))
             self.ram_bytes += array.nbytes
         elif self.spill_dir is not None:
-            path = self._disk_write(len(self._entries), array)
+            try:
+                path = self._disk_write(len(self._entries), array)
+            except Exception as exc:
+                # degradation ladder rung 1: the spill disk failed past
+                # its retries — drop to a host-RAM-only cache for the
+                # rest of the run (this over-budget entry evicts, so the
+                # fill gives up and consumers degrade to forward replay:
+                # slower, never wrong)
+                logger.warning(
+                    "spill disk write failed (%s: %s); degrading to "
+                    "host-RAM-only cache — backward passes will fall "
+                    "back to forward replay",
+                    type(exc).__name__, exc,
+                )
+                _degrade.record(
+                    "spill", "disk_to_ram",
+                    f"{type(exc).__name__}: {exc}",
+                )
+                self.spill_dir = None
+                self.counters["evictions"] += 1
+                self.gave_up = True
+                _metrics.count("spill.evictions")
+                return False
             self._entries.append(("disk", path))
             self.disk_bytes += array.nbytes
         else:
@@ -169,17 +197,28 @@ class SpillCache:
         return self._meta[k]
 
     def get(self, k):
-        """Entry k as a host ndarray (RAM hit or a full disk read)."""
+        """Entry k as a host ndarray (RAM hit or a full disk read).
+        Disk reads retry transient failures with backoff; a read that
+        stays failed raises (the streamed consumer then falls back to
+        forward replay — see `StreamedForward.stream_column_groups`)."""
         kind, payload = self._entries[k]
+
+        def read():
+            fault_point("spill.read")
+            if kind == "ram":
+                return payload
+            with _metrics.stage("spill.disk_read") as st:
+                arr = np.load(payload)
+                st.bytes_moved = int(arr.nbytes)
+            return arr
+
+        out = retry_transient(read, site="spill.read")
         if kind == "ram":
             self.counters["ram_reads"] += 1
-            return payload
-        self.counters["disk_reads"] += 1
-        _metrics.count("spill.disk_reads")
-        with _metrics.stage("spill.disk_read") as st:
-            arr = np.load(payload)
-            st.bytes_moved = int(arr.nbytes)
-        return arr
+        else:
+            self.counters["disk_reads"] += 1
+            _metrics.count("spill.disk_reads")
+        return out
 
     def get_row(self, k, index):
         """One sub-array of entry k (e.g. ``(c, s)`` of a [G, S, ...]
@@ -192,21 +231,31 @@ class SpillCache:
         row's IO, not the entry's.
         """
         kind, payload = self._entries[k]
+
+        def read():
+            fault_point("spill.get_row")
+            if kind == "ram":
+                return payload[index]
+            with _metrics.stage("spill.disk_read") as st:
+                row = np.array(np.load(payload, mmap_mode="r")[index])
+                st.bytes_moved = int(row.nbytes)
+            return row
+
+        out = retry_transient(read, site="spill.get_row")
         if kind == "ram":
             self.counters["ram_reads"] += 1
-            return payload[index]
-        self.counters["disk_reads"] += 1
-        _metrics.count("spill.disk_reads")
-        with _metrics.stage("spill.disk_read") as st:
-            row = np.array(np.load(payload, mmap_mode="r")[index])
-            st.bytes_moved = int(row.nbytes)
-        return row
+        else:
+            self.counters["disk_reads"] += 1
+            _metrics.count("spill.disk_reads")
+        return out
 
     # -- maintenance --------------------------------------------------------
 
     def reset(self):
-        """Back to empty (disk files deleted); counters are kept."""
+        """Back to empty (disk files deleted, orphaned ``.tmp`` files
+        swept); counters are kept."""
         self._clear_entries()
+        self._sweep_orphans()
         self.complete = False
         self.gave_up = False
 
@@ -231,26 +280,62 @@ class SpillCache:
             shutil.rmtree(self._own_dir, ignore_errors=True)
             self._own_dir = None
 
+    def _sweep_orphans(self):
+        """Remove ``.tmp`` siblings a crashed fill left behind — in this
+        cache's own dir and in stale ``swiftly_spill_*`` dirs of a dead
+        process under the shared spill dir. An orphaned tmp is a torn
+        write; left in place it wastes disk and, worse, a later rename
+        collision could surface it as a truncated entry."""
+        roots = []
+        if self._own_dir is not None:
+            roots.append(self._own_dir)
+        if self.spill_dir is not None and os.path.isdir(self.spill_dir):
+            roots.append(os.path.join(self.spill_dir, "swiftly_spill_*"))
+        swept = 0
+        for root in roots:
+            for tmp in glob.glob(os.path.join(root, "*.npy.tmp")):
+                try:
+                    os.remove(tmp)
+                    swept += 1
+                except OSError:  # pragma: no cover - concurrent sweep
+                    pass
+        if swept:
+            logger.warning(
+                "swept %d orphaned spill .tmp file(s) from a crashed "
+                "fill", swept,
+            )
+            _metrics.count("spill.orphans_swept", swept)
+
     def _disk_write(self, k, array):
-        """Chunked memmap write of one entry under the spill dir."""
+        """Chunked memmap write of one entry under the spill dir —
+        ATOMIC (tmp sibling + rename: a crash mid-write can never leave
+        a truncated ``group_*.npy`` that poisons a later cache-fed
+        pass) and retried on transient I/O failure."""
         if self._own_dir is None:
             os.makedirs(self.spill_dir, exist_ok=True)
             self._own_dir = tempfile.mkdtemp(
                 prefix="swiftly_spill_", dir=self.spill_dir
             )
         path = os.path.join(self._own_dir, f"group_{k:05d}.npy")
-        with _metrics.stage("spill.disk_write") as st:
-            mm = np.lib.format.open_memmap(
-                path, mode="w+", dtype=array.dtype, shape=array.shape
-            )
-            row_bytes = max(1, array[:1].nbytes) if array.ndim else 1
-            step = max(1, int(_DISK_CHUNK_BYTES // row_bytes))
-            for s in range(0, array.shape[0], step):
-                mm[s : s + step] = array[s : s + step]
-            mm.flush()
-            del mm
-            st.bytes_moved = int(array.nbytes)
-        return path
+
+        def write():
+            fault_point("spill.write")
+            tmp = path + ".tmp"
+            with _metrics.stage("spill.disk_write") as st:
+                mm = np.lib.format.open_memmap(
+                    tmp, mode="w+", dtype=array.dtype, shape=array.shape
+                )
+                row_bytes = max(1, array[:1].nbytes) if array.ndim else 1
+                step = max(1, int(_DISK_CHUNK_BYTES // row_bytes))
+                for s in range(0, array.shape[0], step):
+                    mm[s : s + step] = array[s : s + step]
+                mm.flush()
+                del mm
+                st.bytes_moved = int(array.nbytes)
+            os.replace(tmp, path)
+            return path
+
+        return retry_transient(write, site="spill.write")
 
     def __del__(self):  # pragma: no cover - interpreter-shutdown path
         try:

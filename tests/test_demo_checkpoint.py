@@ -1,11 +1,16 @@
-"""Kill-and-resume: the demo driver's checkpointed streamed loop.
+"""Kill-and-resume: the demo driver's checkpointed streamed loop,
+plus the hardened checkpoint's failure modes (truncation, bit-flip
+corruption, legacy versions, cross-kind restore, mesh placement).
 
 A run killed mid-stream must resume from its snapshot and produce the
 same facets as an uninterrupted run — without refolding the columns the
-snapshot already holds.
+snapshot already holds; and a snapshot damaged on disk must fall back
+to the previous good generation instead of folding garbage.
 """
 
+import json
 import sys
+import zipfile
 from pathlib import Path
 
 import numpy as np
@@ -92,3 +97,256 @@ def test_kill_and_resume_matches_uninterrupted(tmp_path, residency):
     # fold, so column 3 refolds on resume along with the rest)
     assert folded["cols"] == n_cols - 2
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint failure modes (the resilience hardening contract)
+# ---------------------------------------------------------------------------
+
+
+def _saved_streamed(tmp_path, n_saves=1):
+    """A real sampled-residency snapshot (plus older generations)."""
+    from swiftly_tpu.utils.checkpoint import save_streamed_backward_state
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup()
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    bwd = StreamedBackward(config, facet_configs, residency="sampled",
+                          fold_group=1)
+    ck = tmp_path / "bwd.npz"
+    done = []
+    for k, (items, subgrids) in enumerate(
+        fwd.stream_columns(subgrid_configs)
+    ):
+        bwd.add_subgrids(
+            [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+        )
+        done.extend((sg.off0, sg.off1) for _, sg in items)
+        if k < n_saves:
+            save_streamed_backward_state(ck, bwd, sorted(done))
+    return config, facet_configs, ck
+
+
+def test_truncated_checkpoint_raises_corrupt(tmp_path):
+    """A crash mid-write used to leave a torn .npz; the atomic writer
+    makes that impossible, and a truncated file (simulated here) is
+    classified corrupt — not a crash, not a silent partial restore."""
+    from swiftly_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        restore_streamed_backward_state,
+        verify_checkpoint,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path)
+    blob = ck.read_bytes()
+    ck.write_bytes(blob[: len(blob) // 2])
+    assert verify_checkpoint(ck) != []
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    with pytest.raises(CorruptCheckpointError):
+        restore_streamed_backward_state(ck, bwd)
+
+
+def test_checksum_mismatch_falls_back_to_previous_generation(tmp_path):
+    """A bit-flipped newest generation restores from the previous one
+    (fewer processed subgrids — recompute, never garbage)."""
+    from swiftly_tpu.resilience.faults import corrupt_file
+    from swiftly_tpu.utils.checkpoint import (
+        checkpoint_generations,
+        restore_streamed_backward_state,
+        verify_checkpoint,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path, n_saves=2)
+    gens = checkpoint_generations(ck)
+    assert len(gens) == 2  # newest + one rotation
+    corrupt_file(str(ck))
+    assert verify_checkpoint(ck) != []
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    processed = restore_streamed_backward_state(ck, bwd)
+    # generation 1 was saved after the FIRST column only
+    n_first_col = len([p for p in processed])
+    assert n_first_col >= 1
+    assert bwd.processed == processed
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    from swiftly_tpu.resilience.faults import corrupt_file
+    from swiftly_tpu.utils.checkpoint import (
+        CorruptCheckpointError,
+        checkpoint_generations,
+        restore_streamed_backward_state,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path, n_saves=2)
+    for gen in checkpoint_generations(ck):
+        corrupt_file(gen)
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    with pytest.raises(CorruptCheckpointError, match="generation"):
+        restore_streamed_backward_state(ck, bwd)
+
+
+def _rewrite_meta(ck, mutate):
+    """Re-write the snapshot with a mutated meta (valid CRCs)."""
+    import zlib
+
+    with np.load(ck) as data:
+        arrays = {
+            name: data[name]
+            for name in data.files
+            if name not in ("meta", "meta_crc")
+        }
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+    mutate(meta)
+    meta_bytes = json.dumps(meta).encode()
+    arrays["meta"] = np.frombuffer(meta_bytes, dtype=np.uint8)
+    arrays["meta_crc"] = np.asarray(
+        [zlib.crc32(meta_bytes)], dtype=np.uint32
+    )
+    with open(ck, "wb") as fh:
+        np.savez(fh, **arrays)
+
+
+def test_legacy_version_rejected_loudly(tmp_path):
+    """An unknown snapshot version is a plain ValueError (caller bug /
+    format drift), NOT a corrupt generation to silently skip."""
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path)
+    _rewrite_meta(ck, lambda m: m.update(version=99))
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    with pytest.raises(ValueError, match="Unsupported checkpoint version"):
+        restore_streamed_backward_state(ck, bwd)
+
+
+def test_v1_snapshot_without_checksums_still_restores(tmp_path):
+    """Pre-hardening (v1) snapshots carry no CRC table; they restore
+    with verification skipped rather than being rejected."""
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        verify_checkpoint,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path)
+
+    def to_v1(meta):
+        meta["version"] = 1
+        meta.pop("crc", None)
+
+    _rewrite_meta(ck, to_v1)
+    assert verify_checkpoint(ck) == []
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    processed = restore_streamed_backward_state(ck, bwd)
+    assert processed and bwd._acc is not None
+
+
+def test_cross_kind_restore_rejected(tmp_path):
+    """A streamed snapshot must not restore into a SwiftlyBackward (and
+    vice versa) — the accumulator layouts are not interchangeable."""
+    from swiftly_tpu import SwiftlyBackward
+    from swiftly_tpu.utils.checkpoint import (
+        restore_backward_state,
+        save_backward_state,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path)
+    bwd = SwiftlyBackward(config, facet_configs, 1, 10)
+    with pytest.raises(ValueError, match="streamed_backward"):
+        restore_backward_state(ck, bwd)
+    # and the reverse direction
+    ck2 = tmp_path / "plain.npz"
+    save_backward_state(ck2, bwd, [])
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+    )
+
+    sbwd = StreamedBackward(config, facet_configs, residency="sampled")
+    with pytest.raises(ValueError, match="backward"):
+        restore_streamed_backward_state(ck2, sbwd)
+
+
+def test_checkpoint_file_is_valid_zip_after_kill_during_save(tmp_path):
+    """An injected crash INSIDE the save never tears the live file:
+    either the old generation survives untouched or the new one landed
+    whole (the atomic rename contract)."""
+    from swiftly_tpu.resilience import FaultPlan, faults
+    from swiftly_tpu.resilience.faults import WorkerKilled
+    from swiftly_tpu.utils.checkpoint import (
+        save_streamed_backward_state,
+        verify_checkpoint,
+    )
+
+    config, facet_configs, ck = _saved_streamed(tmp_path)
+    good = ck.read_bytes()
+    bwd2 = StreamedBackward(config, facet_configs, residency="sampled")
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+    )
+
+    restore_streamed_backward_state(ck, bwd2)
+    plan = FaultPlan(
+        faults=[{"site": "checkpoint.save", "kind": "kill", "at": 0}]
+    )
+    with faults.active(plan):
+        with pytest.raises(WorkerKilled):
+            save_streamed_backward_state(ck, bwd2, bwd2.processed)
+    assert ck.read_bytes() == good  # live generation untouched
+    assert verify_checkpoint(ck) == []
+    assert zipfile.is_zipfile(ck)
+
+
+def test_mesh_restore_places_facet_sharded(tmp_path):
+    """`restore_backward_state` with a mesh set re-places the restored
+    accumulators facet-sharded across the mesh (not all on device 0)."""
+    from swiftly_tpu import SwiftlyBackward, SwiftlyForward
+    from swiftly_tpu.parallel.mesh import make_facet_mesh
+    from swiftly_tpu.utils.checkpoint import (
+        restore_backward_state,
+        save_backward_state,
+    )
+
+    mesh = make_facet_mesh()
+    config = SwiftlyConfig(backend="jax", mesh=mesh, **PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    fwd = SwiftlyForward(config, facet_tasks, 2, 50)
+    subgrids = {
+        (sg.off0, sg.off1): fwd.get_subgrid_task(sg)
+        for sg in subgrid_configs
+    }
+    bwd_ref = SwiftlyBackward(config, facet_configs, 2, 50)
+    for sg in subgrid_configs:
+        bwd_ref.add_new_subgrid_task(sg, subgrids[(sg.off0, sg.off1)])
+    facets_ref = np.asarray(bwd_ref.finish())
+
+    half = len(subgrid_configs) // 2
+    bwd1 = SwiftlyBackward(config, facet_configs, 2, 50)
+    done = []
+    for sg in subgrid_configs[:half]:
+        bwd1.add_new_subgrid_task(sg, subgrids[(sg.off0, sg.off1)])
+        done.append((sg.off0, sg.off1))
+    ck = tmp_path / "mesh_bwd.npz"
+    save_backward_state(ck, bwd1, done)
+
+    bwd2 = SwiftlyBackward(config, facet_configs, 2, 50)
+    processed = set(restore_backward_state(ck, bwd2))
+    assert processed == set(done)
+    # the restored accumulators must span the mesh, not sit on one chip
+    restored = [bwd2.lru._store[k] for k in bwd2.lru._store]
+    if bwd2._MNAF_BMNAFs is not None:
+        restored.append(bwd2._MNAF_BMNAFs)
+    assert restored, "snapshot restored no accumulators"
+    for arr in restored:
+        assert len(arr.sharding.device_set) == mesh.size, (
+            f"restored array on {len(arr.sharding.device_set)} device(s),"
+            f" expected facet-sharded over {mesh.size}"
+        )
+    for sg in subgrid_configs[half:]:
+        bwd2.add_new_subgrid_task(sg, subgrids[(sg.off0, sg.off1)])
+    np.testing.assert_allclose(
+        np.asarray(bwd2.finish()), facets_ref, atol=1e-13
+    )

@@ -1,0 +1,556 @@
+"""Resilient-execution layer tests: fault injection, retry/backoff,
+the degradation ladder, hardened checkpoints, and serve resilience.
+
+Three contracts under test:
+
+1. **Clean path untouched** — with no FaultPlan installed, every hook
+   is a payload-identity no-op.
+2. **Deterministic chaos** — a (spec, seed) pair replays the same fault
+   schedule exactly.
+3. **Degrade, never corrupt** — each ladder rung (spill disk -> RAM ->
+   replay, corrupt checkpoint -> previous generation, fused batch ->
+   split -> per-request) produces the SAME numbers as the undisturbed
+   path, just slower, and leaves an auditable trail.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SwiftlyConfig,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+)
+from swiftly_tpu.obs import metrics, validate_resilience_artifact
+from swiftly_tpu.resilience import degrade, faults, retry
+from swiftly_tpu.resilience.faults import (
+    FaultError,
+    FaultPlan,
+    InjectedResourceExhausted,
+    WorkerKilled,
+    corrupt_array,
+    fault_point,
+)
+from swiftly_tpu.resilience.retry import (
+    backoff_delay,
+    is_transient,
+    retry_transient,
+)
+from swiftly_tpu.utils.spill import SpillCache
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+SOURCES = [(1, 1, 0), (0.5, -30, 40)]
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """No plan leaks between tests; the degradation trail starts empty."""
+    faults.uninstall()
+    degrade.reset()
+    yield
+    faults.uninstall()
+    degrade.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault_point: the clean path and the injection kinds
+# ---------------------------------------------------------------------------
+
+
+def test_fault_point_no_plan_is_identity():
+    assert faults.current() is None
+    payload = object()
+    assert fault_point("spill.read", payload) is payload
+    assert fault_point("anything") is None
+
+
+def test_fault_kinds():
+    plan = FaultPlan(
+        faults=[
+            {"site": "a", "kind": "ioerror", "at": 0},
+            {"site": "b", "kind": "oom", "at": 0},
+            {"site": "c", "kind": "kill", "at": 0},
+            {"site": "d", "kind": "latency", "at": 0, "delay_s": 0.0},
+        ]
+    )
+    with faults.active(plan):
+        with pytest.raises(FaultError):
+            fault_point("a")
+        with pytest.raises(InjectedResourceExhausted, match="RESOURCE_EXHAUSTED"):
+            fault_point("b")
+        with pytest.raises(WorkerKilled):
+            fault_point("c")
+        assert fault_point("d", "x") == "x"  # latency returns payload
+    stats = plan.stats()
+    assert stats["total"] == 4
+    assert stats["by_kind"] == {
+        "ioerror": 1, "oom": 1, "kill": 1, "latency": 1
+    }
+
+
+def test_worker_killed_tears_through_exception_handlers():
+    """kill must NOT be absorbable by `except Exception` isolation
+    layers — it simulates process death, not a handled error."""
+    assert not issubclass(WorkerKilled, Exception)
+    plan = FaultPlan(faults=[{"site": "s", "kind": "kill", "at": 0}])
+    with faults.active(plan):
+        with pytest.raises(WorkerKilled):
+            try:
+                fault_point("s")
+            except Exception:  # noqa: BLE001 - the point of the test
+                pytest.fail("WorkerKilled was caught by except Exception")
+
+
+def test_schedule_at_every_times():
+    plan = FaultPlan(
+        faults=[
+            {"site": "x", "kind": "ioerror", "at": 2},
+            {"site": "y", "kind": "ioerror", "every": 3, "times": 2},
+        ]
+    )
+    with faults.active(plan):
+        hits_x = [
+            isinstance(_try_site("x"), FaultError) for _ in range(5)
+        ]
+        hits_y = [
+            isinstance(_try_site("y"), FaultError) for _ in range(10)
+        ]
+    assert hits_x == [False, False, True, False, False]
+    # every=3 fires on calls 0, 3 then exhausts its times=2 cap
+    assert hits_y == [True, False, False, True] + [False] * 6
+
+
+def _try_site(site):
+    try:
+        fault_point(site)
+    except FaultError as exc:
+        return exc
+    return None
+
+
+def test_probabilistic_schedule_is_seed_deterministic():
+    spec = {
+        "seed": 42,
+        "faults": [{"site": "p", "kind": "ioerror", "p": 0.5,
+                    "times": 100}],
+    }
+
+    def run():
+        plan = FaultPlan.from_spec(spec)
+        with faults.active(plan):
+            return [_try_site("p") is not None for _ in range(64)]
+
+    first, second = run(), run()
+    assert first == second
+    assert any(first) and not all(first)
+
+
+def test_corrupt_array_flips_exactly_one_bit():
+    arr = np.arange(64, dtype=np.float32)
+    out = corrupt_array(arr)
+    assert out.shape == arr.shape and out.dtype == arr.dtype
+    a = arr.view(np.uint8)
+    b = out.view(np.uint8)
+    diff = np.unpackbits(a ^ b).sum()
+    assert diff == 1
+
+
+def test_plan_spec_roundtrip():
+    plan = FaultPlan(
+        faults=[{"site": "x", "kind": "oom", "at": 1}], seed=9
+    )
+    again = FaultPlan.from_spec(plan.spec())
+    assert again.spec() == plan.spec()
+
+
+# ---------------------------------------------------------------------------
+# retry_transient: classification, backoff, accounting
+# ---------------------------------------------------------------------------
+
+
+def test_transient_classification():
+    assert is_transient(IOError("disk hiccup"))
+    assert is_transient(TimeoutError())
+    assert is_transient(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_transient(RuntimeError("backend UNAVAILABLE"))
+    assert not is_transient(ValueError("bad shape"))
+    assert not is_transient(RuntimeError("deterministic failure"))
+
+
+def test_retry_recovers_and_counts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    metrics.reset()
+    metrics.enable()
+    try:
+        out = retry_transient(flaky, site="t", sleep=lambda d: None)
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    assert out == "ok" and calls["n"] == 3
+    assert counters["retry.attempts"] == 2
+    assert counters["retry.attempts.t"] == 2
+    assert counters["retry.recovered"] == 1
+
+
+def test_retry_fatal_raises_immediately():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("deterministic")
+
+    with pytest.raises(ValueError):
+        retry_transient(fatal, sleep=lambda d: None)
+    assert calls["n"] == 1  # no pointless retries of a fatal error
+
+
+def test_retry_exhaustion_raises_last_error():
+    def always():
+        raise OSError("still down")
+
+    slept = []
+    metrics.reset()
+    metrics.enable()
+    try:
+        with pytest.raises(OSError):
+            retry_transient(
+                always, site="x", max_attempts=2, sleep=slept.append
+            )
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    assert len(slept) == 2
+    assert counters["retry.exhausted"] == 1
+
+
+def test_retry_max_env_knob(monkeypatch):
+    monkeypatch.setenv("SWIFTLY_RETRY_MAX", "1")
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_transient(always, sleep=lambda d: None)
+    assert calls["n"] == 2  # 1 try + 1 retry
+
+
+def test_backoff_delay_exponential_and_capped():
+    rng = __import__("random").Random(0)
+    d0 = backoff_delay(0, base_s=0.1, max_s=10.0, rng=rng)
+    assert 0.05 <= d0 <= 0.1
+    d5 = backoff_delay(5, base_s=0.1, max_s=1.0, rng=rng)
+    assert d5 <= 1.0  # capped
+
+
+# ---------------------------------------------------------------------------
+# spill cache: atomic writes, orphan sweep, disk->RAM degradation,
+# injected-read retry, mid-feed replay fallback
+# ---------------------------------------------------------------------------
+
+
+def test_spill_disk_write_atomic_and_retried(tmp_path):
+    """An injected transient write failure retries to success; the
+    landed entry reads back exactly and no .tmp sibling remains."""
+    arr = np.arange(1024, dtype=np.float32)
+    cache = SpillCache(budget_bytes=1, spill_dir=str(tmp_path))
+    plan = FaultPlan(
+        faults=[{"site": "spill.write", "kind": "ioerror", "at": 0}]
+    )
+    with faults.active(plan):
+        cache.begin_fill()
+        assert cache.put(0, arr)
+        assert cache.end_fill()
+    np.testing.assert_array_equal(cache.get(0), arr)
+    leftovers = [
+        f for d, _, fs in os.walk(tmp_path) for f in fs
+        if f.endswith(".tmp")
+    ]
+    assert leftovers == []
+    assert plan.stats()["total"] == 1
+
+
+def test_spill_disk_failure_degrades_to_ram_only(tmp_path):
+    """Persistent disk failure steps the ladder down: RAM-only cache,
+    eviction, gave_up (consumers replay) — recorded in the ledger."""
+    cache = SpillCache(budget_bytes=8, spill_dir=str(tmp_path))
+    plan = FaultPlan(
+        faults=[{"site": "spill.write", "kind": "ioerror", "every": 1,
+                 "times": None}]
+    )
+    os.environ["SWIFTLY_RETRY_MAX"] = "1"
+    try:
+        with faults.active(plan):
+            cache.begin_fill()
+            ok = cache.put(0, np.zeros(64, np.float32))
+    finally:
+        del os.environ["SWIFTLY_RETRY_MAX"]
+    assert not ok
+    assert cache.gave_up and cache.spill_dir is None
+    trail = degrade.events()
+    assert any(
+        e["site"] == "spill" and e["action"] == "disk_to_ram"
+        for e in trail
+    )
+
+
+def test_spill_orphan_tmp_sweep(tmp_path):
+    """Stale .tmp files from a crashed fill are swept on begin_fill."""
+    stale_dir = tmp_path / "swiftly_spill_dead"
+    stale_dir.mkdir()
+    stale = stale_dir / "group_00000.npy.tmp"
+    stale.write_bytes(b"torn write")
+    cache = SpillCache(budget_bytes=1e9, spill_dir=str(tmp_path))
+    cache.begin_fill()
+    assert not stale.exists()
+
+
+def test_spill_injected_read_retries_to_identical_value():
+    arr = np.arange(16, dtype=np.float32)
+    cache = SpillCache(budget_bytes=1e9)
+    cache.begin_fill()
+    cache.put(0, arr)
+    cache.end_fill()
+    plan = FaultPlan(
+        faults=[{"site": "spill.read", "kind": "ioerror", "at": 0}]
+    )
+    with faults.active(plan):
+        out = cache.get(0)
+    np.testing.assert_array_equal(out, arr)
+    assert plan.stats()["by_site"] == {"spill.read": 1}
+
+
+def _setup(backend="planar"):
+    config = SwiftlyConfig(backend=backend, **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_configs, subgrid_configs, facet_tasks
+
+
+def test_midfeed_spill_failure_falls_back_to_forward_replay():
+    """A cached group that stays unreadable past its retries mid-feed
+    degrades to replaying the forward — the consumer sees the full
+    stream, bit-identical, and the ledger records the fallback."""
+    from swiftly_tpu.parallel import StreamedForward
+
+    config, _facet_configs, subgrid_configs, facet_tasks = _setup()
+    fwd = StreamedForward(config, facet_tasks, residency="device",
+                          col_group=2)
+    spill = SpillCache(budget_bytes=1e9)
+    ref = [
+        (per_col, np.asarray(g))
+        for per_col, g in fwd.stream_column_groups(
+            subgrid_configs, spill=spill
+        )
+    ]
+    assert spill.complete and len(spill) >= 3
+    # the THIRD read (site call 2) fails persistently: calls 3..5 are
+    # its retries (SWIFTLY_RETRY_MAX default 3), all injected — one
+    # group was already yielded, so the fallback must resume the
+    # forward mid-stream, not restart the consumer
+    plan = FaultPlan(
+        faults=[
+            {"site": "spill.read", "kind": "ioerror", "at": k}
+            for k in (2, 3, 4, 5)
+        ]
+    )
+    with faults.active(plan):
+        out = [
+            (per_col, np.asarray(g))
+            for per_col, g in fwd.stream_column_groups(
+                subgrid_configs, spill=spill
+            )
+        ]
+    assert len(out) == len(ref)
+    for (ref_cols, ref_g), (got_cols, got_g) in zip(ref, out):
+        np.testing.assert_array_equal(got_g, ref_g)
+    assert spill.gave_up and not spill.complete
+    assert any(
+        e["site"] == "spill" and e["action"] == "replay_fallback"
+        for e in degrade.events()
+    )
+
+
+def test_streamed_backward_wall_clock_autosave(tmp_path):
+    """`enable_autosave(every_s=...)` snapshots from inside the feed on
+    a wall-clock cadence; the snapshot restores the processed ledger."""
+    from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+    from swiftly_tpu.utils.checkpoint import (
+        checkpoint_generations,
+        restore_streamed_backward_state,
+    )
+
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("jax")
+    fwd = StreamedForward(config, facet_tasks, col_block=416)
+    bwd = StreamedBackward(config, facet_configs)
+    ck = tmp_path / "auto.npz"
+    bwd.enable_autosave(ck, every_s=1e-6)  # due after every feed call
+    cols = list(fwd.stream_columns(subgrid_configs))[:2]
+    for items, subgrids in cols:
+        bwd.add_subgrids(
+            [(sg, subgrids[s]) for s, (_, sg) in enumerate(items)]
+        )
+    assert checkpoint_generations(ck)
+    bwd2 = StreamedBackward(config, facet_configs)
+    processed = restore_streamed_backward_state(ck, bwd2)
+    assert set(processed) == set(bwd.processed)
+    assert len(processed) == sum(len(items) for items, _ in cols)
+
+
+# ---------------------------------------------------------------------------
+# serve: injected dispatch faults, backoff accounting, OOM batch split
+# ---------------------------------------------------------------------------
+
+
+def _service(cover, **kwargs):
+    from swiftly_tpu import SwiftlyForward
+    from swiftly_tpu.serve import SubgridService
+
+    config, facet_tasks, _sgs = cover
+    fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                         queue_size=50)
+    return SubgridService(fwd, **kwargs)
+
+
+@pytest.fixture(scope="module")
+def cover():
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_tasks, subgrid_configs
+
+
+def test_serve_dispatch_fault_site_retried_to_success(cover):
+    """An injected serve.dispatch failure takes the isolation path and
+    every request still serves; backoff time is accounted in stats."""
+    _config, _tasks, sgs = cover
+    col0 = [sg for sg in sgs if sg.off0 == sgs[0].off0]
+    svc = _service(cover, retry_backoff_s=0.001)
+    plan = FaultPlan(
+        faults=[{"site": "serve.dispatch", "kind": "ioerror", "at": 0}]
+    )
+    with faults.active(plan):
+        reqs = svc.serve(col0)
+    assert all(r.result.ok for r in reqs)
+    st = svc.stats()
+    assert st["batch_failures"] == 1
+    assert st["retries"] == len(col0)
+    assert st["retry_backoff_s"] > 0
+    assert plan.stats()["by_site"] == {"serve.dispatch": 1}
+
+
+def test_serve_oom_batch_splits_before_isolation(cover):
+    """A fused-batch OOM steps down the ladder — split in half — and
+    serves without any per-request retries; results match the
+    per-request reference exactly."""
+    from swiftly_tpu import SwiftlyForward
+
+    config, facet_tasks, sgs = cover
+    col0 = [sg for sg in sgs if sg.off0 == sgs[0].off0]
+    assert len(col0) >= 2
+    svc = _service(cover, retry_backoff_s=0.0)
+    state = {"armed": 1}
+
+    def injector(reqs, attempt):
+        # one OOM against the full coalesced batch; halves succeed
+        if attempt == 0 and len(reqs) == len(col0) and state["armed"]:
+            state["armed"] = 0
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected batch OOM")
+
+    svc.fault_injector = injector
+    reqs = svc.serve(col0)
+    assert all(r.result.ok for r in reqs)
+    st = svc.stats()
+    assert st["batch_splits"] == 1
+    assert st["retries"] == 0  # the split absorbed it; no isolation
+    assert any(
+        e["site"] == "serve" and e["action"] == "batch_split"
+        for e in degrade.events()
+    )
+    fwd_ref = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=50)
+    for sg, req in zip(col0, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(req.result.data),
+            np.asarray(fwd_ref.get_subgrid_task(sg)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# the resilience artifact schema
+# ---------------------------------------------------------------------------
+
+
+def _minimal_resilience_record():
+    from swiftly_tpu.obs import run_manifest
+
+    return {
+        "metric": "chaos-drill test",
+        "value": 1.0,
+        "unit": "s",
+        "manifest": run_manifest(baseline_source=None),
+        "resilience": {
+            "faults_injected": {"spill.read": 1},
+            "faults_injected_total": 1,
+            "faults_survived": 1,
+            "retries": 1,
+            "degradations": [],
+            "resume_count": 1,
+            "bit_identical": True,
+        },
+    }
+
+
+def test_validate_resilience_artifact_accepts_good_record():
+    assert validate_resilience_artifact(_minimal_resilience_record()) == []
+
+
+def test_validate_resilience_artifact_rejects_drift():
+    rec = _minimal_resilience_record()
+    del rec["resilience"]["resume_count"]
+    rec["resilience"]["bit_identical"] = False
+    rec["resilience"]["faults_injected_total"] = 2  # != by-site sum
+    problems = validate_resilience_artifact(rec)
+    assert any("resume_count" in p for p in problems)
+    assert any("bit_identical" in p for p in problems)
+    assert any("faults_injected_total" in p for p in problems)
+    assert validate_resilience_artifact({}) != []
+
+
+def test_degrade_ledger_records_and_resets():
+    degrade.record("x", "stepped_down", detail=123)
+    ev = degrade.events()
+    assert ev == [
+        {"site": "x", "action": "stepped_down", "detail": "123"}
+    ]
+    degrade.reset()
+    assert degrade.events() == []
