@@ -543,9 +543,20 @@ def run_one(config_name, mode):
 
     from swiftly_tpu import SWIFT_CONFIGS, check_subgrid
     from swiftly_tpu.obs import Heartbeat, metrics
+    from swiftly_tpu.obs import trace as otrace
 
     if metrics.enabled():
         metrics.reset()  # one telemetry export per configuration record
+    # the leg's root span: everything below (build, warmup, timed pass,
+    # baseline) nests under it, so trace_report's critical path covers
+    # the whole leg wall. Entered/exited explicitly — the body is not
+    # reindented under a `with` — and `leg_wall_s` brackets the span so
+    # the artifact's trace block can be checked against it.
+    otrace.adopt(0)  # legs are roots, even after a failed leg's leak
+    leg_span = otrace.span("bench.leg", cat="bench",
+                           config=config_name, mode=mode)
+    t_leg0 = time.perf_counter()
+    leg_span.__enter__()
     sparse_fov = None
     if mode.endswith("-sparse"):
         # circular-FoV sparse facet cover, composable with the streamed
@@ -860,8 +871,17 @@ def run_one(config_name, mode):
                 interval_s=float(os.environ.get("BENCH_HEARTBEAT_S", "30")),
                 log=log,
             )
+            from swiftly_tpu.obs import trace as otrace
+
             for kpart, (i0, i1, r0, r1) in enumerate(parts):
                 t_pass = time.time()
+                # the hierarchy's pass level: leg → PASS → column
+                # group → stage (one span per facet x row-slab part)
+                pass_span = otrace.span(
+                    "bwd.pass", cat="bench", part=kpart,
+                    facets=[i0, i1], rows=[r0, r1],
+                )
+                pass_span.__enter__()
                 bwd = StreamedBackward(
                     config, list(facet_configs[i0:i1]),
                     residency="sampled", fold_group=fold_group[0],
@@ -882,6 +902,7 @@ def run_one(config_name, mode):
                 rms2 = _verify_part(facets_dev, i0, i1, r0, r1)
                 max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
                 del facets_dev, bwd
+                pass_span.__exit__(None, None, None)
                 extra["pass_s"].append(round(time.time() - t_pass, 1))
                 if len(parts) > 1:
                     log.info(
@@ -1099,6 +1120,8 @@ def run_one(config_name, mode):
             t_fold = max(0.0, t_fin - t_fin_empty)
             numpy_total += t_fold * n_cols + t_fin_empty
 
+    leg_span.__exit__(None, None, None)
+    leg_wall_s = time.perf_counter() - t_leg0
     direction = (
         "forward+backward round-trip"
         if mode in ("roundtrip", "roundtrip-streamed")
@@ -1146,7 +1169,49 @@ def run_one(config_name, mode):
     )
     if metrics.enabled():
         result["telemetry"] = metrics.export()
+    if otrace.enabled():
+        from swiftly_tpu.obs import summarize_trace
+
+        summary = summarize_trace(
+            otrace.export(), root_id=getattr(leg_span, "id", None)
+        )
+        summary["leg_wall_s"] = round(leg_wall_s, 6)
+        result["trace"] = summary
     return result
+
+
+def _trace_path_from_argv(default="BENCH_trace.json"):
+    """The Chrome-trace output path for this invocation, or None.
+
+    ``--trace [PATH]`` (PATH optional — defaults to ``BENCH_trace.json``
+    next to the other artifacts) turns the span tracer on for the run;
+    ``SWIFTLY_TRACE=1`` + ``SWIFTLY_TRACE_PATH`` are the env twins the
+    manifest records.
+    """
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        nxt = sys.argv[i + 1] if i + 1 < len(sys.argv) else None
+        if nxt and not nxt.startswith("--"):
+            return nxt
+        return os.environ.get("SWIFTLY_TRACE_PATH") or default
+    from swiftly_tpu.obs import trace as otrace
+
+    if otrace.enabled():  # SWIFTLY_TRACE=1 at process start
+        return otrace.path() or os.environ.get(
+            "SWIFTLY_TRACE_PATH"
+        ) or default
+    return None
+
+
+def _maybe_enable_trace():
+    """Enable the span tracer when ``--trace``/``SWIFTLY_TRACE`` asks
+    for it; returns the output path (None = tracing off)."""
+    path = _trace_path_from_argv()
+    if path:
+        from swiftly_tpu.obs import trace as otrace
+
+        otrace.enable(path)
+    return path
 
 
 def _zipf_workload(subgrid_configs, n_requests, seed, zipf_s=1.1):
@@ -1223,6 +1288,7 @@ def serve_bench(smoke_mode=False):
         stream=sys.stderr,
     )
     enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
     out_path = os.environ.get("BENCH_SERVE_OUT", "BENCH_serve.json")
     if smoke_mode:
         os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
@@ -1305,7 +1371,11 @@ def serve_bench(smoke_mode=False):
         hot_off0, hot_col[0].off1, hot_col[0].size,
         np.ones(hot_col[0].size + 3), None,
     )
+    from swiftly_tpu.obs import trace as otrace
+
+    serve_span = otrace.span("bench.serve", cat="bench", config=name)
     t0 = time.time()
+    serve_span.__enter__()
     for k, burst in enumerate(bursts):
         if k == 2:
             spill.reset()  # forced eviction: feed index now dangles
@@ -1328,6 +1398,7 @@ def serve_bench(smoke_mode=False):
             tracked.append((poisoned, service.submit(poisoned)))
         while service.pump_once():
             pass
+    serve_span.__exit__(None, None, None)
     wall = time.time() - t0
 
     # bit-identity audit: every served result vs per-request
@@ -1381,6 +1452,16 @@ def serve_bench(smoke_mode=False):
     }
     if metrics.enabled():
         record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+
+        summary = summarize_trace(
+            otrace.export(), root_id=getattr(serve_span, "id", None)
+        )
+        summary["leg_wall_s"] = round(wall, 6)
+        record["trace"] = summary
+        otrace.save(trace_path)
+        otrace.disable()
 
     problems = validate_serve_artifact(record)
     if smoke_mode:
@@ -1423,6 +1504,41 @@ def serve_bench(smoke_mode=False):
             )
         elif "p50_s" not in t_stages["serve.request"]:
             problems.append("serve.request stage missing p50_s")
+        # request journeys: every served request's queue/compute/
+        # transfer segments must SUM to its end-to-end latency (they
+        # are contiguous timestamp diffs — the p99 decomposition
+        # contract), and the stats block aggregates them
+        n_journeys = n_bad = 0
+        for _sg, req in tracked:
+            res = req.result
+            if res is None or not res.ok:
+                continue
+            if not res.journey:
+                n_bad += 1
+                continue
+            n_journeys += 1
+            total = sum(res.journey.values())
+            if abs(total - res.latency_s) > 1e-6 + 1e-4 * res.latency_s:
+                n_bad += 1
+        if not n_journeys or n_bad:
+            problems.append(
+                f"journey decomposition failed: {n_journeys} journeys, "
+                f"{n_bad} missing/not summing to end-to-end latency"
+            )
+        if not stats.get("journey"):
+            problems.append("stats missing journey decomposition block")
+        if trace_path:
+            from swiftly_tpu.obs import validate_trace_artifact
+
+            problems.extend(validate_trace_artifact(record))
+            tr_j = (record.get("trace") or {}).get("journeys") or {}
+            if not tr_j.get("n_requests"):
+                problems.append("trace holds no serve.journey spans")
+        gm = (telemetry.get("gauges_max") or {})
+        if "serve.queue_depth_peak" not in gm:
+            problems.append(
+                "gauges_max missing serve.queue_depth_peak watermark"
+            )
     with open(out_path, "w") as fh:
         json.dump(record, fh, indent=2)
     if smoke_mode:
@@ -1464,6 +1580,7 @@ def smoke():
         stream=sys.stderr,
     )
     enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
     out_path = os.environ.get("BENCH_SMOKE_OUT", "BENCH_smoke.json")
     jsonl_path = os.environ.get(
         "SWIFTLY_METRICS_JSONL", out_path + "l"
@@ -1532,6 +1649,8 @@ def smoke():
             f"JSONL event log has stage names {sorted(jsonl_stages)}, "
             "expected >= 6 engine stages"
         )
+    if trace_path:
+        problems.extend(_check_smoke_trace(record, trace_path))
     with open(out_path, "w") as fh:
         _json.dump(record, fh, indent=2)
     metrics.disable()
@@ -1542,6 +1661,7 @@ def smoke():
                 "config": name,
                 "artifact": out_path,
                 "jsonl": jsonl_path,
+                "trace": trace_path,
                 "n_engine_stages": len(engine_stages),
                 "problems": problems,
             }
@@ -1549,6 +1669,47 @@ def smoke():
         flush=True,
     )
     return 0 if not problems else 1
+
+
+def _check_smoke_trace(record, trace_path):
+    """Save + validate the smoke leg's timeline: structurally valid
+    Chrome trace JSON (Perfetto-loadable), a trace block whose schema
+    passes `validate_trace_artifact`, a critical path rooted at
+    `bench.leg` whose wall matches the measured leg wall within 5%,
+    and the engine stage vocabulary present as spans."""
+    from swiftly_tpu.obs import report as oreport
+    from swiftly_tpu.obs import trace as otrace
+    from swiftly_tpu.obs import validate_trace_artifact
+
+    problems = list(validate_trace_artifact(record))
+    otrace.save(trace_path)
+    otrace.disable()
+    trace = oreport.load_trace(trace_path)
+    problems += [
+        f"trace file: {p}" for p in oreport.validate_trace_events(trace)
+    ]
+    tr = record.get("trace") or {}
+    wall, leg_wall = tr.get("wall_s"), tr.get("leg_wall_s")
+    if not wall or not leg_wall or abs(wall - leg_wall) > 0.05 * leg_wall:
+        problems.append(
+            f"critical-path root wall {wall} != measured leg wall "
+            f"{leg_wall} within 5%"
+        )
+    if (tr.get("critical_path") or [{}])[0].get("name") != "bench.leg":
+        problems.append(
+            f"critical path does not start at bench.leg: "
+            f"{tr.get('critical_path')}"
+        )
+    span_names = {
+        s["name"] for s in oreport.build_tree(trace).values()
+    }
+    want = {"bench.leg", "fwd.column_group", "bwd.sampled_fold",
+            "spill.write", "spill.read"}
+    if not want <= span_names:
+        problems.append(
+            f"trace missing engine spans {sorted(want - span_names)}"
+        )
+    return problems
 
 
 def run_chaos_drill(config_name, fault_plan=None, fold_group=2,
@@ -1778,6 +1939,7 @@ def chaos(smoke_mode=False):
         stream=sys.stderr,
     )
     enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
     out_path = os.environ.get("BENCH_CHAOS_OUT", "BENCH_chaos.json")
     metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
     name = os.environ.get(
@@ -1796,6 +1958,16 @@ def chaos(smoke_mode=False):
         baseline_source=None, params=dict(SWIFT_CONFIGS[name])
     )
     record["telemetry"] = metrics.export()
+    if trace_path:
+        # a chaos-drill trace shows WHERE the run degraded: the fault
+        # injections and ladder steps land as instant events among the
+        # pass/group/stage spans
+        from swiftly_tpu.obs import summarize_trace
+        from swiftly_tpu.obs import trace as otrace
+
+        record["trace"] = summarize_trace(otrace.export())
+        otrace.save(trace_path)
+        otrace.disable()
     problems = validate_resilience_artifact(record)
     res = record["resilience"]
     # the drill's own invariants, beyond the schema: the schedule must
@@ -1859,6 +2031,7 @@ def main():
         stream=sys.stderr,
     )
     enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
     # incremental per-leg flush: a killed run (BENCH_r05 died at rc=124)
     # still leaves every FINISHED leg's full record on disk, plus a
     # "started" marker naming the leg it died in. BENCH_PARTIAL_PATH=""
@@ -1952,6 +2125,10 @@ def main():
             fail_record = {"metric": f"{name} ({mode})", "error": "failed"}
             print(json.dumps(fail_record), flush=True)
             partial.append(fail_record)
+    if trace_path:
+        from swiftly_tpu.obs import trace as otrace
+
+        otrace.save(trace_path)
     if state["headline_line"]:
         print(state["headline_line"], flush=True)
     sys.exit(0 if ok.get(len(entries) - 1) else 1)

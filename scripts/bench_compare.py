@@ -1,0 +1,263 @@
+"""Perf regression sentinel: diff a BENCH artifact against baselines.
+
+Every PR so far has grown the artifact pile (``BENCH_r0*.json``,
+``BENCH_smoke.json``, ``BENCH_partial.jsonl``) but nothing DIFFS them
+— a 20% wall regression lands silently until a human re-reads the
+numbers. This sentinel compares the latest artifact's legs against one
+or more reference artifacts (the ``BENCH_r0*.json`` trajectory,
+``BASELINE.json`` when it carries published numbers, or any prior
+artifact) and exits non-zero when a leg regressed:
+
+* **wall** — latest ``value`` (seconds, lower is better) more than
+  ``--threshold`` (default 20%) SLOWER than the best reference for the
+  same (config, mode);
+* **MFU** — latest ``mfu_pct`` more than the threshold BELOW the best
+  reference.
+
+Legs are matched by (config, mode) — taken from the stamped
+``manifest.config_params`` when present (every record since PR 1),
+else parsed from the metric string (the r0* trajectory predates the
+manifest). Records from DIFFERENT platforms (cpu smoke vs tpu runs)
+are never compared: a cross-platform "regression" is a category error,
+and it is reported as skipped instead.
+
+Accepted file shapes: a single BENCH record, a list of records, a
+JSONL of records (``BENCH_partial.jsonl``), or the round-ledger shape
+``{"parsed": record}`` of ``BENCH_r0*.json``.
+
+Usage:
+    python scripts/bench_compare.py BENCH_smoke.json \
+        --against 'BENCH_r0*.json' [--threshold 0.2] [--json]
+
+Exit: 0 ok / nothing comparable, 1 regression detected, 2 bad input.
+Wired into tier-1 via tests/test_bench_smoke.py (the smoke artifact is
+compared against itself — a sentinel that cries wolf on identical
+numbers would be worse than none — and against a doctored faster
+baseline, which must trip it).
+"""
+
+import argparse
+import glob
+import json
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# metric strings look like
+#   "32k[1]-n16k-512 forward facet->subgrid wall-clock (842 subgrids,
+#    planar f32, roundtrip-streamed, tpu)"
+_METRIC_RE = re.compile(
+    r"^(?P<config>\S+)\s.*\(.*?,\s*(?P<mode>[\w-]+),\s*(?P<platform>\w+)\)"
+)
+
+
+def load_records(path):
+    """Every BENCH record in ``path`` (see module docstring shapes)."""
+    text = Path(path).read_text()
+    records = []
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # JSONL (BENCH_partial.jsonl)
+        data = [
+            json.loads(line) for line in text.splitlines() if line.strip()
+        ]
+    if isinstance(data, dict):
+        if "parsed" in data and isinstance(data["parsed"], (dict, list)):
+            data = data["parsed"]
+        if isinstance(data, dict):
+            data = [data]
+    for rec in data:
+        if isinstance(rec, dict) and "metric" in rec and "value" in rec:
+            records.append(rec)
+    return records
+
+
+def leg_key(record):
+    """(config, mode) identity of one leg, or None when unparseable."""
+    manifest = record.get("manifest") or {}
+    params = manifest.get("config_params") or {}
+    if params.get("config"):
+        return (params["config"], params.get("mode", "?"))
+    m = _METRIC_RE.match(record.get("metric", ""))
+    if m:
+        return (m.group("config"), m.group("mode"))
+    return None
+
+
+def leg_platform(record):
+    manifest = record.get("manifest") or {}
+    platform = (manifest.get("device") or {}).get("platform")
+    if platform:
+        return platform
+    m = _METRIC_RE.match(record.get("metric", ""))
+    return m.group("platform") if m else None
+
+
+def compare(latest_records, reference_records, threshold=0.2):
+    """Per-leg verdicts: each latest leg against the BEST same-platform
+    reference for its (config, mode). Returns a JSON-ready report with
+    ``regressions`` non-empty when the sentinel should fail."""
+    refs = {}  # (key, platform) -> {"wall": best, "mfu": best, "n": int}
+    for rec in reference_records:
+        key = leg_key(rec)
+        if key is None or rec.get("skipped") or rec.get("error"):
+            continue
+        bucket = refs.setdefault(
+            (key, leg_platform(rec)), {"wall": None, "mfu": None, "n": 0}
+        )
+        bucket["n"] += 1
+        value = rec.get("value")
+        if isinstance(value, (int, float)):
+            if bucket["wall"] is None or value < bucket["wall"]:
+                bucket["wall"] = value
+        mfu = rec.get("mfu_pct")
+        if isinstance(mfu, (int, float)):
+            if bucket["mfu"] is None or mfu > bucket["mfu"]:
+                bucket["mfu"] = mfu
+
+    legs, regressions, skipped = [], [], []
+    for rec in latest_records:
+        key = leg_key(rec)
+        if key is None or rec.get("skipped") or rec.get("error"):
+            continue
+        platform = leg_platform(rec)
+        ref = refs.get((key, platform))
+        if ref is None:
+            why = (
+                "no same-platform reference"
+                if any(k == key for k, _p in refs)
+                else "no reference leg"
+            )
+            skipped.append(
+                {"config": key[0], "mode": key[1],
+                 "platform": platform, "reason": why}
+            )
+            continue
+        verdict = {
+            "config": key[0],
+            "mode": key[1],
+            "platform": platform,
+            "wall_s": rec.get("value"),
+            "ref_wall_s": ref["wall"],
+            "mfu_pct": rec.get("mfu_pct"),
+            "ref_mfu_pct": ref["mfu"],
+            "n_reference_runs": ref["n"],
+            "problems": [],
+        }
+        value = rec.get("value")
+        if (
+            isinstance(value, (int, float))
+            and ref["wall"] is not None
+            and value > ref["wall"] * (1.0 + threshold)
+        ):
+            verdict["problems"].append(
+                f"wall {value:.4g}s is "
+                f"{100 * (value / ref['wall'] - 1):.1f}% slower than "
+                f"best reference {ref['wall']:.4g}s "
+                f"(threshold {100 * threshold:.0f}%)"
+            )
+        mfu = rec.get("mfu_pct")
+        if (
+            isinstance(mfu, (int, float))
+            and ref["mfu"] is not None
+            and mfu < ref["mfu"] * (1.0 - threshold)
+        ):
+            verdict["problems"].append(
+                f"mfu {mfu:.4g}% is "
+                f"{100 * (1 - mfu / ref['mfu']):.1f}% below best "
+                f"reference {ref['mfu']:.4g}%"
+            )
+        legs.append(verdict)
+        if verdict["problems"]:
+            regressions.append(verdict)
+    return {
+        "threshold": threshold,
+        "n_latest_legs": len(legs),
+        "n_reference_legs": sum(b["n"] for b in refs.values()),
+        "legs": legs,
+        "skipped": skipped,
+        "regressions": regressions,
+        "ok": not regressions and (bool(legs) or not latest_records),
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="diff a BENCH artifact against baseline artifacts"
+    )
+    parser.add_argument(
+        "latest", help="the artifact under test (JSON or JSONL)"
+    )
+    parser.add_argument(
+        "--against", action="append", default=[],
+        metavar="GLOB",
+        help="reference artifact path/glob; repeatable "
+             "(default: BENCH_r0*.json + BASELINE.json in the repo root)",
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.2,
+        help="per-leg wall/MFU regression threshold (default 0.20)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full report as one JSON object",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        latest = load_records(args.latest)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"cannot read {args.latest}: {exc}", file=sys.stderr)
+        return 2
+    globs = args.against or [
+        str(Path(__file__).resolve().parent.parent / "BENCH_r0*.json"),
+        str(Path(__file__).resolve().parent.parent / "BASELINE.json"),
+    ]
+    reference = []
+    for pattern in globs:
+        for path in sorted(glob.glob(pattern)):
+            if Path(path).resolve() == Path(args.latest).resolve():
+                continue  # an artifact is not its own baseline
+            try:
+                reference.append((path, load_records(path)))
+            except (OSError, json.JSONDecodeError) as exc:
+                print(f"skipping {path}: {exc}", file=sys.stderr)
+    report = compare(
+        latest,
+        [rec for _path, recs in reference for rec in recs],
+        threshold=args.threshold,
+    )
+    report["latest"] = args.latest
+    report["reference_files"] = [path for path, _recs in reference]
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        for leg in report["legs"]:
+            status = "REGRESSED" if leg["problems"] else "ok"
+            print(
+                f"{status:>9}  {leg['config']} ({leg['mode']}, "
+                f"{leg['platform']}): wall {leg['wall_s']} vs "
+                f"{leg['ref_wall_s']} ref"
+                + (
+                    f", mfu {leg['mfu_pct']} vs {leg['ref_mfu_pct']}"
+                    if leg["mfu_pct"] is not None
+                    else ""
+                )
+            )
+            for p in leg["problems"]:
+                print(f"           - {p}")
+        for s in report["skipped"]:
+            print(
+                f"  skipped  {s['config']} ({s['mode']}, "
+                f"{s['platform']}): {s['reason']}"
+            )
+        if not report["legs"] and not report["skipped"]:
+            print("nothing comparable (no matching legs)")
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

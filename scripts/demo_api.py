@@ -22,6 +22,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from scripts.utils import (
     cli_parser,
+    enable_observability,
     human_readable_size,
     make_sources,
     resolve_mesh,
@@ -297,10 +298,7 @@ def main():
     args = parser.parse_args()
     setup_jax(args)
 
-    if args.metrics or args.metrics_jsonl:
-        from swiftly_tpu.obs import metrics
-
-        metrics.enable(args.metrics_jsonl)
+    trace_path = enable_observability(args)
 
     from swiftly_tpu import SWIFT_CONFIGS
 
@@ -308,8 +306,18 @@ def main():
         params = dict(SWIFT_CONFIGS[name])
         params.setdefault("fov", 1.0)
         log.info("=== %s ===", name)
-        max_err = demo_api(args, params, config_name=name)
+        from swiftly_tpu.obs import trace as otrace
+
+        with otrace.span("demo.run", cat="demo", config=name):
+            max_err = demo_api(args, params, config_name=name)
         log.info("%s: max facet RMS error %e", name, max_err)
+    if trace_path:
+        from swiftly_tpu.obs import trace as otrace
+
+        otrace.save(trace_path)
+        log.info("trace written: %s (load in Perfetto, or "
+                 "`python scripts/trace_report.py %s`)",
+                 trace_path, trace_path)
 
 
 if __name__ == "__main__":

@@ -23,7 +23,7 @@ import numpy as np
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from scripts.utils import cli_parser, make_sources, setup_jax
+from scripts.utils import cli_parser, enable_observability, make_sources, setup_jax
 
 log = logging.getLogger("swiftly-tpu.demo-serve")
 
@@ -80,14 +80,14 @@ def main(argv=None):
         make_full_subgrid_cover,
     )
     from swiftly_tpu.obs import metrics
+    from swiftly_tpu.obs import trace as otrace
     from swiftly_tpu.serve import (
         AdmissionQueue,
         CoalescingScheduler,
         SubgridService,
     )
 
-    if args.metrics:
-        metrics.enable(args.metrics_jsonl or None)
+    trace_path = enable_observability(args)
 
     name = args.swift_config.split(",")[0]
     params = dict(SWIFT_CONFIGS[name])
@@ -136,6 +136,11 @@ def main(argv=None):
         timeout_s=args.timeout_s,
         slo_ms=args.slo_ms,
     )
+    # the run's root span opens BEFORE service.start(): the worker
+    # thread adopts the caller's trace context at start(), so pump
+    # spans (and the per-request journey tracks) nest under the run
+    serve_span = otrace.span("demo.serve", cat="demo", config=name)
+    serve_span.__enter__()
     if args.threaded:
         service.start()
     reqs = []
@@ -153,6 +158,7 @@ def main(argv=None):
             r.wait()
         service.stop()
     wall = time.time() - t0
+    serve_span.__exit__(None, None, None)
 
     stats = service.stats()
     stats["wall_s"] = round(wall, 3)
@@ -160,6 +166,11 @@ def main(argv=None):
         round(stats["n_served"] / wall, 2) if wall else 0.0
     )
     print(json.dumps(stats, indent=2))
+    if trace_path:
+        otrace.save(trace_path)
+        log.info("trace written: %s (load in Perfetto, or "
+                 "`python scripts/trace_report.py %s`)",
+                 trace_path, trace_path)
     if args.metrics:
         exported = metrics.export()
         print(json.dumps(

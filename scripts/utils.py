@@ -155,7 +155,33 @@ def cli_parser(description: str) -> argparse.ArgumentParser:
         help="also append per-stage telemetry events to this JSONL file "
              "(implies --metrics; equivalent to SWIFTLY_METRICS_JSONL)",
     )
+    parser.add_argument(
+        "--trace",
+        type=str,
+        default=None,
+        metavar="PATH",
+        help="record a hierarchical span timeline (swiftly_tpu.obs."
+             "trace) and write Perfetto-loadable Chrome trace-event "
+             "JSON to PATH at exit (equivalent to SWIFTLY_TRACE=1 + "
+             "SWIFTLY_TRACE_PATH; inspect with scripts/trace_report.py)",
+    )
     return parser
+
+
+def enable_observability(args):
+    """Turn on the metrics registry and/or span tracer the CLI asked
+    for; returns the trace path (None = tracing off). The demos call
+    this once after parse_args — one switchboard, identical knobs."""
+    if getattr(args, "metrics", False) or getattr(args, "metrics_jsonl", None):
+        from swiftly_tpu.obs import metrics
+
+        metrics.enable(args.metrics_jsonl or None)
+    trace_path = getattr(args, "trace", None)
+    if trace_path:
+        from swiftly_tpu.obs import trace
+
+        trace.enable(trace_path)
+    return trace_path
 
 
 def setup_jax(args):

@@ -16,16 +16,27 @@ perf artifact this repo emits is *measured, attributed and auditable*:
   env knobs, git SHA, config hash, ``baseline_source``) stamped into
   every BENCH artifact, plus the artifact schema validator the
   ``bench.py --smoke`` leg runs.
+* ``obs.trace`` — the hierarchical span tracer (run → bench leg →
+  pass → column group → stage; serve request journeys on per-request
+  tracks; HBM watermarks at span boundaries), exporting Chrome
+  trace-event JSON loadable in Perfetto. Same one-attribute-check
+  discipline when disabled; every ``metrics.stage`` site doubles as a
+  trace site through the bridge.
+* ``obs.report`` — trace analysis: span trees, critical-path/self-time
+  attribution (``scripts/trace_report.py``), journey decomposition,
+  and the ``trace`` artifact-block schema check.
 * ``obs.heartbeat`` — progress reporting for hour-scale runs
   (units/s, ETA) and incremental partial-artifact flushing so a killed
   run still leaves its finished legs on disk.
 
 Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
-``SWIFTLY_METRICS_JSONL``) or programmatically with
-``metrics.enable(...)``. See docs/observability.md.
+``SWIFTLY_METRICS_JSONL``) / ``SWIFTLY_TRACE=1`` (Chrome JSON in
+``SWIFTLY_TRACE_PATH``) or programmatically with
+``metrics.enable(...)`` / ``trace.enable(path)``. See
+docs/observability.md.
 """
 
-from . import metrics
+from . import metrics, report, trace
 from .heartbeat import Heartbeat, PartialArtifactWriter
 from .manifest import (
     run_manifest,
@@ -33,13 +44,18 @@ from .manifest import (
     validate_resilience_artifact,
     validate_serve_artifact,
 )
+from .report import summarize_trace, validate_trace_artifact
 
 __all__ = [
     "Heartbeat",
     "PartialArtifactWriter",
     "metrics",
+    "report",
     "run_manifest",
+    "summarize_trace",
+    "trace",
     "validate_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
+    "validate_trace_artifact",
 ]

@@ -115,6 +115,11 @@ def run_manifest(baseline_source=None, params=None, extra=None) -> dict:
         jax_version = None
     import numpy as np
 
+    # the tracing state is recorded explicitly (not just via the env
+    # capture): a programmatic trace.enable(path) leaves no env trail,
+    # but the artifact must still name the timeline it belongs to
+    from . import trace as _trace_mod
+
     manifest = {
         "schema": MANIFEST_SCHEMA,
         "timestamp_utc": time.strftime(
@@ -131,6 +136,10 @@ def run_manifest(baseline_source=None, params=None, extra=None) -> dict:
         "argv": list(sys.argv),
         "env": env,
         "baseline_source": baseline_source,
+        "trace": {
+            "enabled": bool(_trace_mod.enabled()),
+            "path": _trace_mod.path(),
+        },
     }
     if params is not None:
         manifest["config_params"] = dict(params)
@@ -235,6 +244,25 @@ def validate_serve_artifact(record):
         problems.append(
             "missing bit_identical {checked, mismatches} block"
         )
+    journey = record.get("journey")
+    if isinstance(journey, dict):
+        # the request-journey decomposition must partition the served
+        # wall: segment shares sum to 1 (each segment is a contiguous
+        # timestamp diff of the same per-request interval)
+        shares = [
+            journey[seg]["share"]
+            for seg in ("queue", "compute", "transfer")
+            if isinstance(journey.get(seg), dict)
+            and "share" in journey[seg]
+        ]
+        if len(shares) != 3:
+            problems.append(
+                "journey block missing queue/compute/transfer segments"
+            )
+        elif not 0.99 <= sum(shares) <= 1.01:
+            problems.append(
+                f"journey segment shares sum to {sum(shares)}, not 1"
+            )
     return problems
 
 

@@ -35,6 +35,8 @@ import os
 import threading
 import time
 
+from . import trace as _trace
+
 __all__ = [
     "MetricsRegistry",
     "enabled",
@@ -45,6 +47,7 @@ __all__ = [
     "observe",
     "count",
     "gauge",
+    "gauge_max",
     "event",
     "export",
     "reset",
@@ -118,9 +121,17 @@ def _p99(samples):
 
 
 class _Stage:
-    """One enabled stage timing: host wall + TraceAnnotation pairing."""
+    """One enabled stage timing: host wall + TraceAnnotation pairing.
 
-    __slots__ = ("_reg", "name", "flops", "bytes_moved", "_t0", "_ann")
+    Also the metrics→trace bridge: when the span tracer (``obs.trace``)
+    is on, each stage opens a trace span of the SAME name, so every
+    instrumentation site in the engine feeds both systems with one
+    ``with`` block and the Perfetto timeline uses the documented stage
+    vocabulary. A stage may run with the registry disabled (tracing
+    only) — it then records no registry state."""
+
+    __slots__ = ("_reg", "name", "flops", "bytes_moved", "_t0", "_ann",
+                 "_tspan")
 
     def __init__(self, reg, name, flops, bytes_moved):
         self._reg = reg
@@ -128,21 +139,32 @@ class _Stage:
         self.flops = flops
         self.bytes_moved = bytes_moved
         self._ann = None
+        self._tspan = None
 
     def __enter__(self):
         reg = self._reg
         if reg._annotation_cls is not None:
             self._ann = reg._annotation_cls(self.name)
             self._ann.__enter__()
+        if _trace._TRACER.enabled:
+            self._tspan = _trace.span(self.name, cat="stage")
+            self._tspan.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         wall = time.perf_counter() - self._t0
+        if self._tspan is not None:
+            if self.flops:
+                self._tspan.set(flops=self.flops)
+            if self.bytes_moved:
+                self._tspan.set(bytes_moved=self.bytes_moved)
+            self._tspan.__exit__(*exc)
         if self._ann is not None:
             self._ann.__exit__(*exc)
-        self._reg._record_stage(self.name, wall, self.flops,
-                                self.bytes_moved)
+        if self._reg.enabled:
+            self._reg._record_stage(self.name, wall, self.flops,
+                                    self.bytes_moved)
         return False
 
 
@@ -162,6 +184,7 @@ class MetricsRegistry:
         self._t0 = time.perf_counter()
         self.counters = {}
         self.gauges = {}
+        self.gauges_max = {}
         self.stages = {}
         self.enabled = False
         if enabled:
@@ -207,6 +230,7 @@ class MetricsRegistry:
         with self._lock:
             self.counters = {}
             self.gauges = {}
+            self.gauges_max = {}
             self.stages = {}
             self._t0 = time.perf_counter()
             self._t_epoch = time.time()
@@ -218,9 +242,11 @@ class MetricsRegistry:
 
         ``flops``/``bytes_moved`` are the dispatch's analytic compute
         and data-movement attribution (accumulated into the stage).
-        Disabled this returns a shared no-op object immediately.
+        Disabled this returns a shared no-op object immediately —
+        unless the span tracer is on, in which case the stage runs as
+        a trace-only span (no registry state).
         """
-        if not self.enabled:
+        if not self.enabled and not _trace._TRACER.enabled:
             return _NULL_STAGE
         return _Stage(self, name, flops, bytes_moved)
 
@@ -247,6 +273,18 @@ class MetricsRegistry:
             return
         with self._lock:
             self.gauges[name] = value
+
+    def gauge_max(self, name, value):
+        """Peak-tracking gauge: keeps the MAX ever recorded, so
+        watermark-style gauges (HBM peak, queue-depth high-water)
+        survive ``export()`` on long runs instead of reporting
+        whatever the last sample happened to be."""
+        if not self.enabled:
+            return
+        with self._lock:
+            cur = self.gauges_max.get(name)
+            if cur is None or value > cur:
+                self.gauges_max[name] = value
 
     def event(self, kind, **fields):
         """Append a free-form event to the JSONL log (no-op otherwise)."""
@@ -346,6 +384,7 @@ class MetricsRegistry:
                 "t_epoch": self._t_epoch,
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
+                "gauges_max": dict(self.gauges_max),
                 "stages": stages,
                 "total": total,
             }
@@ -386,7 +425,8 @@ def reset():
 
 
 def stage(name, flops=0, bytes_moved=0):
-    if not _REGISTRY.enabled:  # keep the disabled path one check deep
+    # keep the disabled path shallow: two attribute checks, shared no-op
+    if not _REGISTRY.enabled and not _trace._TRACER.enabled:
         return _NULL_STAGE
     return _Stage(_REGISTRY, name, flops, bytes_moved)
 
@@ -401,6 +441,10 @@ def count(name, n=1):
 
 def gauge(name, value):
     _REGISTRY.gauge(name, value)
+
+
+def gauge_max(name, value):
+    _REGISTRY.gauge_max(name, value)
 
 
 def event(kind, **fields):

@@ -1,0 +1,325 @@
+"""Trace analysis: span trees, critical-path attribution, journey
+decomposition, and the trace artifact schema check.
+
+Consumes the Chrome trace-event JSON written by ``obs.trace`` (every
+span an ``"X"`` event whose args carry ``span_id``/``parent_id``) and
+answers the operator questions the raw timeline only shows visually:
+
+* **critical path** — for a root span (a bench leg, a serve run), the
+  dominant child chain and the top-k spans by aggregated *self time*
+  (wall minus children). Self times partition the root's wall exactly,
+  so the printed attribution always sums back to the leg wall — the
+  invariant ``bench.py --smoke --trace`` asserts within 5%.
+* **journey decomposition** — ``serve.journey.*`` segment totals
+  (queue wait vs compute vs transfer share), the p99-outlier
+  decomposition of the serving SLO harness.
+* **HBM watermarks** — the max ``hbm_peak_bytes`` any span carried.
+
+``summarize_trace`` builds the JSON block bench artifacts stamp as
+``record["trace"]``; ``validate_trace_artifact`` is its schema guard
+(the ``validate_serve_artifact`` twin); ``validate_trace_events`` is
+the structural Chrome-format check (Perfetto-loadable or not) that
+``scripts/trace_report.py`` and the tier-1 tests run.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = [
+    "TRACE_ARTIFACT_FIELDS",
+    "build_tree",
+    "critical_path",
+    "journey_stats",
+    "load_trace",
+    "self_times",
+    "summarize_trace",
+    "validate_trace_artifact",
+    "validate_trace_events",
+]
+
+TRACE_SCHEMA = "swiftly-tpu-trace/1"
+
+
+def load_trace(path):
+    """The Chrome trace dict at ``path`` (accepts the bare event list
+    some tools emit, normalising to the object form)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if isinstance(data, list):
+        data = {"traceEvents": data}
+    return data
+
+
+def validate_trace_events(trace):
+    """Structural problems with a Chrome trace dict (empty = loads in
+    Perfetto): event list present, required per-phase fields, complete
+    events with non-negative microsecond durations."""
+    problems = []
+    if not isinstance(trace, dict):
+        return [f"trace is {type(trace).__name__}, expected dict"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing traceEvents list"]
+    if not events:
+        problems.append("traceEvents is empty")
+    for i, e in enumerate(events):
+        if not isinstance(e, dict):
+            problems.append(f"event {i} is {type(e).__name__}")
+            continue
+        ph = e.get("ph")
+        if ph not in ("X", "i", "I", "M", "b", "e", "B", "E", "C"):
+            problems.append(f"event {i} has unknown ph {ph!r}")
+            continue
+        if ph == "M":
+            continue
+        for field in ("name", "pid", "tid", "ts"):
+            if field not in e:
+                problems.append(f"event {i} ({ph}) missing {field!r}")
+        if ph == "X":
+            dur = e.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i} (X) has bad dur {dur!r}")
+    return problems
+
+
+def build_tree(trace):
+    """Span records from a trace dict: ``{id: {name, cat, ts_s, dur_s,
+    parent, children, args}}``. Spans whose parent never closed (or a
+    cross-process import) are treated as roots."""
+    spans = {}
+    for e in trace.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        sid = args.get("span_id")
+        if sid is None:
+            continue
+        spans[sid] = {
+            "id": sid,
+            "name": e.get("name", "?"),
+            "cat": e.get("cat", ""),
+            "tid": e.get("tid"),
+            "ts_s": float(e.get("ts", 0.0)) / 1e6,
+            "dur_s": float(e.get("dur", 0.0)) / 1e6,
+            "parent": args.get("parent_id", 0) or 0,
+            "children": [],
+            "args": args,
+        }
+    for s in spans.values():
+        if s["parent"] not in spans:
+            s["parent"] = 0
+    for s in spans.values():
+        if s["parent"]:
+            spans[s["parent"]]["children"].append(s["id"])
+    return spans
+
+
+def _subtree_ids(spans, root_id):
+    out, stack = [], [root_id]
+    while stack:
+        sid = stack.pop()
+        out.append(sid)
+        stack.extend(spans[sid]["children"])
+    return out
+
+
+def self_times(spans):
+    """Per-span self time (wall minus direct children's wall, clamped
+    at 0 against clock jitter). Self times of a subtree sum to the
+    root's wall exactly when no child overhangs its parent."""
+    out = {}
+    for sid, s in spans.items():
+        child_wall = sum(spans[c]["dur_s"] for c in s["children"])
+        out[sid] = max(0.0, s["dur_s"] - child_wall)
+    return out
+
+
+def _roots(spans, root_id=None):
+    if root_id is not None:
+        return [root_id] if root_id in spans else []
+    return [sid for sid, s in spans.items() if not s["parent"]]
+
+
+def critical_path(spans, root_id=None):
+    """The dominant chain: from the longest root, repeatedly descend
+    into the longest child. Returns ``[{name, dur_s, self_s}, ...]``
+    root-first (sequential siblings are ALL on the critical path of a
+    single-threaded trace — the chain names where the time is, the
+    self-time table says how much each level keeps for itself)."""
+    roots = _roots(spans, root_id)
+    if not roots:
+        return []
+    selfs = self_times(spans)
+    sid = max(roots, key=lambda r: spans[r]["dur_s"])
+    chain = []
+    while True:
+        s = spans[sid]
+        chain.append(
+            {
+                "name": s["name"],
+                "dur_s": round(s["dur_s"], 6),
+                "self_s": round(selfs[sid], 6),
+            }
+        )
+        if not s["children"]:
+            return chain
+        sid = max(s["children"], key=lambda c: spans[c]["dur_s"])
+
+
+def aggregate(spans, root_id=None):
+    """Per-name aggregation over the (sub)tree: count, total wall,
+    self wall, max HBM watermark. Sorted by self time, descending."""
+    selfs = self_times(spans)
+    if root_id is not None and root_id in spans:
+        ids = _subtree_ids(spans, root_id)
+    else:
+        ids = list(spans)
+    by_name = {}
+    for sid in ids:
+        s = spans[sid]
+        a = by_name.setdefault(
+            s["name"],
+            {"name": s["name"], "count": 0, "total_s": 0.0,
+             "self_s": 0.0, "hbm_peak_bytes": None},
+        )
+        a["count"] += 1
+        a["total_s"] += s["dur_s"]
+        a["self_s"] += selfs[sid]
+        hbm = s["args"].get("hbm_peak_bytes")
+        if hbm is not None:
+            a["hbm_peak_bytes"] = max(a["hbm_peak_bytes"] or 0, int(hbm))
+    out = sorted(by_name.values(), key=lambda a: -a["self_s"])
+    for a in out:
+        a["total_s"] = round(a["total_s"], 6)
+        a["self_s"] = round(a["self_s"], 6)
+    return out
+
+
+def journey_stats(spans):
+    """Serve request-journey decomposition from the ``serve.journey.*``
+    segment spans: per-segment totals and the share of end-to-end
+    request wall each claims (queue-wait share is the p99 postmortem
+    headline). None when the trace holds no journeys."""
+    segs = {}
+    total = 0.0
+    n = 0
+    for s in spans.values():
+        if s["name"] == "serve.journey":
+            total += s["dur_s"]
+            n += 1
+        elif s["name"].startswith("serve.journey."):
+            seg = s["name"].rsplit(".", 1)[1]
+            segs[seg] = segs.get(seg, 0.0) + s["dur_s"]
+    if not n:
+        return None
+    out = {"n_requests": n, "total_s": round(total, 6)}
+    for seg, t in sorted(segs.items()):
+        out[f"{seg}_s"] = round(t, 6)
+        out[f"{seg}_share"] = round(t / total, 4) if total else 0.0
+    return out
+
+
+def summarize_trace(trace, root_id=None, top_k=5):
+    """The JSON block bench artifacts stamp as ``record["trace"]``:
+    span counts, the root wall, top-k self-time attribution, the
+    critical-path chain, journey decomposition and the HBM peak."""
+    spans = build_tree(trace)
+    roots = _roots(spans, root_id)
+    selfs = self_times(spans)
+    if root_id is None and roots:
+        root_id = max(roots, key=lambda r: spans[r]["dur_s"])
+    wall = spans[root_id]["dur_s"] if root_id in spans else 0.0
+    sub = set(_subtree_ids(spans, root_id)) if root_id in spans else set()
+    attributed = sum(selfs[sid] for sid in sub)
+    hbm = [
+        int(s["args"]["hbm_peak_bytes"])
+        for s in spans.values()
+        if s["args"].get("hbm_peak_bytes") is not None
+    ]
+    out = {
+        "schema": TRACE_SCHEMA,
+        "span_count": len(spans),
+        "event_count": sum(
+            1 for e in trace.get("traceEvents", ())
+            if e.get("ph") in ("i", "I")
+        ),
+        "root": spans[root_id]["name"] if root_id in spans else None,
+        "wall_s": round(wall, 6),
+        "attributed_s": round(attributed, 6),
+        "critical_path": critical_path(spans, root_id),
+        "top": aggregate(spans, root_id)[:top_k],
+        "hbm_peak_bytes": max(hbm) if hbm else None,
+    }
+    journeys = journey_stats(spans)
+    if journeys:
+        out["journeys"] = journeys
+    return out
+
+
+# The block every ``--trace`` BENCH artifact must carry — the timeline's
+# schema contract, guarded the same way validate_serve_artifact guards
+# the SLO block.
+TRACE_ARTIFACT_FIELDS = (
+    "schema",
+    "span_count",
+    "wall_s",
+    "attributed_s",
+    "critical_path",
+    "top",
+)
+
+
+def validate_trace_artifact(record):
+    """Problems with a traced BENCH artifact, as a list of strings.
+
+    The record must carry a ``trace`` block with recorded spans, a
+    positive root wall, a non-empty critical path, and self-time
+    attribution that sums back to the root wall within 5% — an
+    attribution that doesn't cover the leg is a broken span tree, not
+    a timeline.
+    """
+    problems = []
+    if not isinstance(record, dict):
+        return [f"record is {type(record).__name__}, expected dict"]
+    tr = record.get("trace")
+    if not isinstance(tr, dict):
+        return ["missing trace block"]
+    for field in TRACE_ARTIFACT_FIELDS:
+        if field not in tr:
+            problems.append(f"trace block missing {field!r}")
+    if tr.get("schema") not in (None, TRACE_SCHEMA):
+        problems.append(
+            f"trace schema {tr.get('schema')!r} != {TRACE_SCHEMA!r}"
+        )
+    sc = tr.get("span_count")
+    if isinstance(sc, int) and sc < 1:
+        problems.append("trace recorded no spans")
+    wall = tr.get("wall_s")
+    if isinstance(wall, (int, float)) and wall <= 0:
+        problems.append(f"trace wall_s {wall!r} not positive")
+    cp = tr.get("critical_path")
+    if isinstance(cp, list):
+        if not cp:
+            problems.append("critical_path is empty")
+        for k, entry in enumerate(cp):
+            if not isinstance(entry, dict) or not (
+                {"name", "dur_s", "self_s"} <= set(entry)
+            ):
+                problems.append(
+                    f"critical_path[{k}] missing name/dur_s/self_s"
+                )
+    elif cp is not None:
+        problems.append("critical_path is not a list")
+    att = tr.get("attributed_s")
+    if (
+        isinstance(wall, (int, float))
+        and isinstance(att, (int, float))
+        and wall > 0
+        and not (0.95 * wall <= att <= 1.05 * wall)
+    ):
+        problems.append(
+            f"attributed self time {att} does not cover the root wall "
+            f"{wall} within 5%"
+        )
+    return problems

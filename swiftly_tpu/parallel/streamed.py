@@ -137,6 +137,7 @@ except ImportError:  # pragma: no cover - older jax
 from .mesh import FACET_AXIS, mesh_size as _mesh_size, varying  # noqa: E402
 
 from ..obs import metrics as _metrics  # noqa: E402
+from ..obs import trace as _trace  # noqa: E402
 from ..resilience import degrade as _degrade  # noqa: E402
 from ..resilience.faults import fault_point as _fault_point  # noqa: E402
 from ..resilience.retry import retry_transient as _retry  # noqa: E402
@@ -2492,18 +2493,21 @@ class StreamedForward:
 
         pending = None
         for k in range(len(spill)):
-            with _metrics.stage("spill.read") as st:
-                host = spill.get(k)
-                st.bytes_moved = int(host.nbytes)
-
-            def upload():
-                _fault_point("transfer.h2d")
-                with _metrics.stage("spill.h2d") as st:
-                    arr = jnp.asarray(host)
+            # the feed's group span closes before the yield (generator
+            # contextvars leak to the consumer between yields)
+            with _trace.span("spill.feed_group", cat="spill", group=k):
+                with _metrics.stage("spill.read") as st:
+                    host = spill.get(k)
                     st.bytes_moved = int(host.nbytes)
-                return arr
 
-            dev = _retry(upload, site="transfer.h2d")
+                def upload():
+                    _fault_point("transfer.h2d")
+                    with _metrics.stage("spill.h2d") as st:
+                        arr = jnp.asarray(host)
+                        st.bytes_moved = int(host.nbytes)
+                    return arr
+
+                dev = _retry(upload, site="transfer.h2d")
             if _metrics.enabled():
                 _metrics.count("spill.prefetch_hits")
             if pending is not None:
@@ -2736,23 +2740,34 @@ class StreamedForward:
             # round-trip — on the tunnel-attached TPU runtime here,
             # block_until_ready returns before the queue drains, so pull
             # an 8-byte checksum of the previous group instead.
-            if prev_tail is not None:
-                with _metrics.stage("fwd.drain"):
-                    np.asarray(prev_tail)
-            with _metrics.stage("fwd.sampled_facet_pass", flops=fp_flops):
-                buf = samfn(*self._dev_facets, e0, krows)  # [F, G*m, yB]
-            with _metrics.stage(
-                "fwd.column_pass", flops=cp_flops, bytes_moved=coll_bytes
+            # one trace span per column group (run → leg → pass →
+            # COLUMN GROUP → stage); closed before the yield because a
+            # generator's contextvars are visible to the consumer
+            # between yields — the consumer's spans must not nest here
+            with _trace.span(
+                "fwd.column_group", cat="fwd",
+                group=g0 // G, n_cols=len(grp),
             ):
-                out_g = gcolfn(
-                    buf,
-                    base._foffs0,
-                    base._foffs1,
-                    jnp.asarray(sg_offs_g),
-                    jnp.asarray(np.asarray(m0_g), rdt),
-                    jnp.asarray(np.asarray(m1_g), rdt),
-                )  # [G, S, xA, xA(,2)]
-            prev_tail = jnp.sum(out_g)
+                if prev_tail is not None:
+                    with _metrics.stage("fwd.drain"):
+                        np.asarray(prev_tail)
+                with _metrics.stage(
+                    "fwd.sampled_facet_pass", flops=fp_flops
+                ):
+                    buf = samfn(*self._dev_facets, e0, krows)
+                with _metrics.stage(
+                    "fwd.column_pass", flops=cp_flops,
+                    bytes_moved=coll_bytes,
+                ):
+                    out_g = gcolfn(
+                        buf,
+                        base._foffs0,
+                        base._foffs1,
+                        jnp.asarray(sg_offs_g),
+                        jnp.asarray(np.asarray(m0_g), rdt),
+                        jnp.asarray(np.asarray(m1_g), rdt),
+                    )  # [G, S, xA, xA(,2)]
+                prev_tail = jnp.sum(out_g)
             if _metrics.enabled():
                 _metrics.count(
                     "fwd.subgrids",
@@ -2966,6 +2981,16 @@ class StreamedForward:
         )
         for g0 in range(0, len(col_offs0), G):
             grp = col_offs0[g0 : g0 + G]
+            # one trace span per column group (the tentpole hierarchy:
+            # run → leg → pass → COLUMN GROUP → stage); entered/exited
+            # explicitly so it closes BEFORE the yield — contextvars
+            # set in a generator are visible to the consumer between
+            # yields, and the consumer's spans must not nest in here
+            grp_span = _trace.span(
+                "fwd.column_group", cat="fwd",
+                group=g0 // G, n_cols=len(grp),
+            )
+            grp_span.__enter__()
             grp_padded = grp + [grp[-1]] * (G - len(grp))
             krows = jnp.asarray(sampled_row_indices(core, grp_padded))
             sg_offs_g, m0_g, m1_g = [], [], []
@@ -3066,6 +3091,7 @@ class StreamedForward:
             with _metrics.stage("fwd.group_finish"):
                 finished = finfn(acc, so_c, m0_c, m1_c)
             del acc
+            grp_span.__exit__(None, None, None)
             if _metrics.enabled():
                 _metrics.count(
                     "fwd.subgrids",
@@ -3430,6 +3456,8 @@ class StreamedBackward:
         a["since"] = 0
         a["last_t"] = time.monotonic()
         _metrics.count("ckpt.autosaves")
+        _trace.instant("ckpt.autosave_tick", cat="ckpt",
+                       processed=len(self.processed))
 
     def _bwd_cp_flops(self, n_subgrids, subgrid_size):
         """Analytic FLOPs of one backward column pass over `n_subgrids`

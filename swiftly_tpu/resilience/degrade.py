@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 
 __all__ = ["events", "record", "reset"]
 
@@ -40,6 +41,10 @@ def record(site, action, detail=None):
     _metrics.count(f"degrade.{site}.{action}")
     _metrics.event("degrade", site=site, action=action,
                    detail=str(detail) if detail is not None else None)
+    # ladder steps land on the trace too: a chaos-drill timeline shows
+    # WHERE the run degraded, not just that it did
+    _trace.instant(f"degrade.{site}.{action}", cat="degrade",
+                   site=site, action=action)
     with _lock:
         if len(_events) >= _MAX_EVENTS:
             _dropped += 1

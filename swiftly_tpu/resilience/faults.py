@@ -54,6 +54,7 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 
 __all__ = [
     "KINDS",
@@ -219,6 +220,10 @@ class FaultPlan:
         _metrics.count("fault.injected")
         _metrics.count(f"fault.injected.{site}")
         _metrics.event("fault", site=site, fault_kind=hit.kind, call=n)
+        # a chaos-drill trace shows WHERE the run was hit: each
+        # injection is an instant event on the recorded timeline
+        _trace.instant("fault.injected", cat="fault", site=site,
+                       fault_kind=hit.kind, call=n)
         if hit.kind == "ioerror":
             raise FaultError(f"injected IOError at {site} (call {n})")
         if hit.kind == "oom":

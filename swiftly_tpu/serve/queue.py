@@ -69,16 +69,23 @@ class RequestResult:
     :param batch_size: number of requests the serving dispatch carried
     :param coalesced: True when the request shared its column program
         with at least one other request
+
+    ``journey`` (set by the service on served requests) decomposes
+    ``latency_s`` into contiguous segments
+    ``{"queue_s", "compute_s", "transfer_s"}`` that SUM to it exactly:
+    admission→taken (queue wait), taken→dispatch-landed (coalesce +
+    compute), dispatch-landed→completion (d2h/result materialisation +
+    completion bookkeeping).
     """
 
     __slots__ = (
         "status", "data", "error", "latency_s", "path", "batch_size",
-        "coalesced", "retries", "shed_reason",
+        "coalesced", "retries", "shed_reason", "journey",
     )
 
     def __init__(self, status, data=None, error=None, latency_s=0.0,
                  path=None, batch_size=0, coalesced=False, retries=0,
-                 shed_reason=None):
+                 shed_reason=None, journey=None):
         self.status = status
         self.data = data
         self.error = error
@@ -88,6 +95,7 @@ class RequestResult:
         self.coalesced = coalesced
         self.retries = retries
         self.shed_reason = shed_reason
+        self.journey = journey
 
     @property
     def ok(self):
@@ -114,7 +122,7 @@ class SubgridRequest:
 
     __slots__ = (
         "config", "req_id", "priority", "submit_t", "deadline_t",
-        "retries", "result", "_event",
+        "retries", "result", "_event", "take_t", "compute_t",
     )
 
     def __init__(self, config, priority=0, deadline_s=None, now=None):
@@ -128,6 +136,12 @@ class SubgridRequest:
         self.retries = 0
         self.result = None
         self._event = threading.Event()
+        # journey marks (set by the queue/pump): when the request left
+        # the queue and when its compute landed — with submit_t and the
+        # completion time these decompose end-to-end latency into
+        # queue-wait / compute / transfer segments that sum exactly
+        self.take_t = None
+        self.compute_t = None
 
     def expired(self, now):
         return self.deadline_t is not None and now > self.deadline_t
@@ -229,6 +243,7 @@ class AdmissionQueue:
             self._cols.setdefault(request.config.off0, []).append(request)
             self._depth += 1
             _metrics.gauge("serve.queue_depth", self._depth)
+            _metrics.gauge_max("serve.queue_depth_peak", self._depth)
             return True, None
 
     def columns(self):
@@ -252,9 +267,12 @@ class AdmissionQueue:
                 )
             return out
 
-    def take(self, off0, limit=None):
+    def take(self, off0, limit=None, now=None):
         """Remove and return up to ``limit`` requests of one column,
-        highest priority first (FIFO within a priority)."""
+        highest priority first (FIFO within a priority). Each taken
+        request's ``take_t`` journey mark is stamped here — the end of
+        its queue-wait segment."""
+        now = time.perf_counter() if now is None else now
         with self._lock:
             reqs = self._cols.get(off0)
             if not reqs:
@@ -269,6 +287,8 @@ class AdmissionQueue:
                 taken = reqs[:limit]
                 self._cols[off0] = reqs[limit:]
             self._depth -= len(taken)
+            for r in taken:
+                r.take_t = now
             _metrics.gauge("serve.queue_depth", self._depth)
             return taken
 

@@ -54,6 +54,7 @@ import time
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..resilience import degrade as _degrade
 from ..resilience.faults import fault_point as _fault_point
 from ..resilience.retry import backoff_delay as _backoff_delay
@@ -175,6 +176,10 @@ class SubgridService:
         self._shed_reasons = {}
         self._latencies = []
         self._lat_i = 0
+        # journey ring, parallel to _latencies: (queue_s, compute_s,
+        # transfer_s) per served request — the p99 decomposition
+        self._journeys = []
+        self._jour_i = 0
         self._pump_lock = threading.Lock()
         self._cond = threading.Condition()
         self._stop = False
@@ -210,6 +215,8 @@ class SubgridService:
             )
             _metrics.count("serve.shed")
             _metrics.count(f"serve.shed.{reason}")
+            _trace.instant("serve.shed", cat="serve",
+                           request_id=req.req_id, reason=reason)
             req._complete(
                 RequestResult(STATUS_SHED, shed_reason=reason)
             )
@@ -295,6 +302,7 @@ class SubgridService:
                 continue
             self._counts["cache_hits"] += 1
             _metrics.count("serve.cache_hits")
+            req.compute_t = time.perf_counter()  # feed read ≙ compute
             self._finish(
                 req,
                 RequestResult(
@@ -350,6 +358,9 @@ class SubgridService:
             self._retry_singly(requests, exc)
             return
         coalesced = len(requests) > 1
+        t_compute = time.perf_counter()
+        for req in requests:
+            req.compute_t = t_compute
         if coalesced:
             self._counts["coalesced"] += len(requests)
             _metrics.count("serve.coalesce.hits", len(requests))
@@ -390,6 +401,7 @@ class SubgridService:
                 except Exception as exc:  # noqa: BLE001 - isolation layer
                     last_err = exc
                     continue
+                req.compute_t = time.perf_counter()
                 self._finish(
                     req,
                     RequestResult(
@@ -404,6 +416,8 @@ class SubgridService:
                 self.quarantined.append((req, err))
                 self._counts["quarantined"] += 1
                 _metrics.count("serve.quarantined")
+                _trace.instant("serve.quarantine", cat="serve",
+                               request_id=req.req_id, error=err)
                 log.error(
                     "request %r quarantined after %d retries: %s",
                     req, req.retries, err,
@@ -423,6 +437,21 @@ class SubgridService:
             self._counts["served"] += 1
             _metrics.count("serve.served")
             _metrics.observe("serve.request", result.latency_s)
+            if req.take_t is not None and req.compute_t is not None:
+                # contiguous timestamp diffs: the three segments sum to
+                # latency_s EXACTLY (same `now`, monotonic clock) — the
+                # p99-outlier decomposition contract
+                result.journey = {
+                    "queue_s": req.take_t - req.submit_t,
+                    "compute_s": req.compute_t - req.take_t,
+                    "transfer_s": now - req.compute_t,
+                }
+                if len(self._journeys) < _LATENCY_RING:
+                    self._journeys.append(result.journey)
+                else:
+                    self._journeys[self._jour_i] = result.journey
+                    self._jour_i = (self._jour_i + 1) % _LATENCY_RING
+                self._trace_journey(req, result, now)
             if len(self._latencies) < _LATENCY_RING:
                 self._latencies.append(result.latency_s)
             else:
@@ -436,6 +465,28 @@ class SubgridService:
                 _metrics.count("serve.slo_violations")
         req._complete(result)
 
+    @staticmethod
+    def _trace_journey(req, result, now):
+        """Emit the request journey onto the trace as one per-request
+        track: an umbrella ``serve.journey`` span with the queue /
+        compute / transfer segments as children — Perfetto shows one
+        row per request, and trace_report decomposes p99 outliers."""
+        if not _trace.enabled():
+            return
+        tid = _trace.JOURNEY_TID_BASE + (req.req_id % (1 << 20))
+        root = _trace.add_span(
+            "serve.journey", req.submit_t, now, cat="serve", tid=tid,
+            request_id=req.req_id, path=result.path,
+            batch_size=result.batch_size, retries=result.retries,
+        )
+        for name, t0, t1 in (
+            ("serve.journey.queue", req.submit_t, req.take_t),
+            ("serve.journey.compute", req.take_t, req.compute_t),
+            ("serve.journey.transfer", req.compute_t, now),
+        ):
+            _trace.add_span(name, t0, t1, cat="serve", tid=tid,
+                            parent=root, request_id=req.req_id)
+
     # -- worker thread ------------------------------------------------------
 
     def start(self):
@@ -443,13 +494,19 @@ class SubgridService:
         if self._thread is not None:
             raise RuntimeError("service already started")
         self._stop = False
+        # contextvars do not flow into Thread targets: hand the worker
+        # the CALLER's span context so pump spans nest under the run
+        # (not as orphan roots) in a recorded trace
+        trace_ctx = _trace.current()
         self._thread = threading.Thread(
-            target=self._run, name="subgrid-service", daemon=True
+            target=self._run, args=(trace_ctx,),
+            name="subgrid-service", daemon=True,
         )
         self._thread.start()
         return self
 
-    def _run(self):
+    def _run(self, trace_ctx=0):
+        _trace.adopt(trace_ctx)
         while True:
             n = self.pump_once()
             if n:
@@ -511,6 +568,7 @@ class SubgridService:
             "p50_ms": round(_quantile(lat, 0.50) * 1e3, 3),
             "p99_ms": round(_quantile(lat, 0.99) * 1e3, 3),
             "max_ms": round((lat[-1] if lat else 0.0) * 1e3, 3),
+            "journey": self._journey_stats(),
         }
         if self.slo_ms is not None:
             out["slo_ms"] = self.slo_ms
@@ -519,4 +577,28 @@ class SubgridService:
                 round(1.0 - c["slo_violations"] / served, 4)
                 if served else 1.0
             )
+        return out
+
+    def _journey_stats(self):
+        """The request-journey decomposition block: per-segment p50/p99
+        and each segment's share of total served wall — where a p99
+        latency regression LIVES (queue wait vs compute vs transfer),
+        not just that it happened."""
+        if not self._journeys:
+            return None
+        total = sum(
+            j["queue_s"] + j["compute_s"] + j["transfer_s"]
+            for j in self._journeys
+        )
+        out = {"n": len(self._journeys)}
+        for seg in ("queue_s", "compute_s", "transfer_s"):
+            vals = sorted(j[seg] for j in self._journeys)
+            seg_total = sum(vals)
+            key = seg[:-2]  # "queue_s" -> "queue"
+            out[key] = {
+                "p50_ms": round(_quantile(vals, 0.50) * 1e3, 3),
+                "p99_ms": round(_quantile(vals, 0.99) * 1e3, 3),
+                "total_s": round(seg_total, 6),
+                "share": round(seg_total / total, 4) if total else 0.0,
+            }
         return out

@@ -29,6 +29,11 @@ docs/resilience.md):
 * **Fault sites.** ``checkpoint.save`` / ``checkpoint.save.done`` /
   ``checkpoint.restore`` are `resilience.faults` hook points — the
   chaos drill corrupts and kills here on a schedule.
+* **Observability.** The ``ckpt.save`` / ``ckpt.restore`` stage timers
+  double as trace spans when `obs.trace` is on (the metrics→trace
+  bridge), so a recorded timeline shows save/restore windows — with
+  bytes attribution — inline with the passes they interrupt, and
+  generation fallbacks land as ``degrade.checkpoint.*`` instants.
 
 Config-mismatch errors (wrong params/backend/kind/version) are
 deliberately NOT retried against older generations: every generation

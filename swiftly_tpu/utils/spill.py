@@ -45,6 +45,7 @@ import tempfile
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..resilience import degrade as _degrade
 from ..resilience.faults import fault_point
 from ..resilience.retry import retry_transient
@@ -128,6 +129,7 @@ class SpillCache:
         self.gave_up = False
         self.tag = tag
         self.counters["fills"] += 1
+        _trace.instant("spill.begin_fill", cat="spill", tag=str(tag))
 
     def put(self, meta, array) -> bool:
         """Append one group's host array (+ its per-column metadata).
@@ -171,6 +173,9 @@ class SpillCache:
             self.counters["evictions"] += 1
             self.gave_up = True
             _metrics.count("spill.evictions")
+            _trace.instant("spill.evict", cat="spill",
+                           entry=len(self._entries),
+                           nbytes=int(array.nbytes))
             return False
         self._meta.append(meta)
         return True
@@ -179,6 +184,11 @@ class SpillCache:
         """Seal the fill: the cache is complete iff nothing was evicted
         and at least one entry landed."""
         self.complete = bool(self._entries) and not self.gave_up
+        _trace.instant(
+            "spill.end_fill", cat="spill", entries=len(self._entries),
+            complete=self.complete, ram_bytes=int(self.ram_bytes),
+            disk_bytes=int(self.disk_bytes),
+        )
         if self.gave_up:
             logger.warning(
                 "spill cache gave up: stream exceeds the %.1f GiB RAM "

@@ -20,6 +20,7 @@ REPO = Path(__file__).resolve().parents[1]
 def test_bench_smoke_leg(tmp_path):
     out = tmp_path / "BENCH_smoke.json"
     jsonl = tmp_path / "BENCH_smoke.jsonl"
+    trace_out = tmp_path / "BENCH_trace.json"
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -32,9 +33,11 @@ def test_bench_smoke_leg(tmp_path):
         BENCH_SKIP_WARM_PASS="1",
     )
     # a fresh interpreter: the smoke must pass from cold, the way the
-    # driver invokes it (no conftest x64/devices settings leak in)
+    # driver invokes it (no conftest x64/devices settings leak in).
+    # --trace rides the same run: the ISSUE-5 acceptance timeline.
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py"), "--smoke"],
+        [sys.executable, str(REPO / "bench.py"), "--smoke",
+         "--trace", str(trace_out)],
         cwd=tmp_path, env=env, capture_output=True, text=True,
         timeout=540,
     )
@@ -84,6 +87,54 @@ def test_bench_smoke_leg(tmp_path):
     }
     assert len({s for s in names if s.startswith(("fwd.", "bwd."))}) >= 6
 
+    # --- the recorded timeline (ISSUE-5 acceptance) -------------------
+    # structurally valid Chrome trace-event JSON (Perfetto-loadable),
+    # a trace block passing the schema guard, and a critical path that
+    # matches the measured leg wall within 5%
+    from swiftly_tpu.obs import report as oreport
+    from swiftly_tpu.obs import validate_trace_artifact
+
+    trace = oreport.load_trace(trace_out)
+    assert oreport.validate_trace_events(trace) == []
+    assert validate_trace_artifact(record) == []
+    tr = record["trace"]
+    assert tr["span_count"] >= 10
+    assert tr["critical_path"][0]["name"] == "bench.leg"
+    assert abs(tr["wall_s"] - tr["leg_wall_s"]) <= 0.05 * tr["leg_wall_s"]
+    # trace_report reproduces the attribution FROM THE FILE: its
+    # critical-path total (sum of self times) covers the leg wall
+    summary2 = oreport.summarize_trace(trace)
+    assert summary2["root"] == "bench.leg"
+    assert (
+        abs(summary2["attributed_s"] - tr["leg_wall_s"])
+        <= 0.05 * tr["leg_wall_s"]
+    )
+    span_names = {s["name"] for s in oreport.build_tree(trace).values()}
+    assert {"bench.leg", "bwd.pass", "fwd.column_group",
+            "bwd.sampled_fold", "spill.write", "spill.read",
+            "spill.feed_group"} - span_names == set()
+    # the manifest names the timeline it belongs to
+    assert record["manifest"]["trace"]["enabled"] is True
+
+    # --- the perf regression sentinel (in-process: no extra spawn) ----
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_ref.json"
+    ref.write_text(json.dumps(record))
+    # same numbers → green (a sentinel that cries wolf on identical
+    # artifacts would be worse than none)
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 0
+    # doctored 2x-faster baseline → the sentinel must trip non-zero
+    doctored = dict(record)
+    doctored["value"] = record["value"] / 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 1
+
 
 def test_bench_serve_smoke_leg(tmp_path):
     """The `bench.py --serve --smoke` leg: a zipf-over-columns workload
@@ -94,6 +145,7 @@ def test_bench_serve_smoke_leg(tmp_path):
     validated in a fresh interpreter — serving schema drift fails here,
     in tier-1, not in a production latency regression."""
     out = tmp_path / "BENCH_serve.json"
+    trace_out = tmp_path / "BENCH_serve_trace.json"
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -101,7 +153,8 @@ def test_bench_serve_smoke_leg(tmp_path):
         BENCH_PARTIAL_PATH="",
     )
     proc = subprocess.run(
-        [sys.executable, str(REPO / "bench.py"), "--serve", "--smoke"],
+        [sys.executable, str(REPO / "bench.py"), "--serve", "--smoke",
+         "--trace", str(trace_out)],
         cwd=tmp_path, env=env, capture_output=True, text=True,
         timeout=540,
     )
@@ -139,6 +192,28 @@ def test_bench_serve_smoke_leg(tmp_path):
     assert counters["serve.coalesce.hits"] >= 1
     assert counters["serve.quarantined"] == 1
     assert counters["lru.hit"] >= 1 and counters["lru.miss"] >= 1
+
+    # request journeys: the stats block decomposes the served wall into
+    # queue/compute/transfer shares that partition it, and the recorded
+    # timeline carries one serve.journey track per served request
+    journey = record["journey"]
+    assert journey["n"] == record["n_served"]
+    shares = [
+        journey[seg]["share"] for seg in ("queue", "compute", "transfer")
+    ]
+    assert abs(sum(shares) - 1.0) < 0.01
+    # the queue-depth high-water survived export via gauge_max
+    assert telemetry["gauges_max"]["serve.queue_depth_peak"] >= 1
+    from swiftly_tpu.obs import report as oreport
+
+    trace = oreport.load_trace(trace_out)
+    assert oreport.validate_trace_events(trace) == []
+    tr_journeys = (record["trace"] or {}).get("journeys")
+    assert tr_journeys and tr_journeys["n_requests"] == record["n_served"]
+    spans = oreport.build_tree(trace)
+    assert sum(
+        1 for s in spans.values() if s["name"] == "serve.journey"
+    ) == record["n_served"]
 
 
 def _run_chaos(tmp_path, extra_args=(), config=None, timeout=540):
