@@ -260,8 +260,11 @@ def _rms2_device(core, got, want):
 
 
 def _is_oom(exc) -> bool:
-    text = f"{type(exc).__name__}: {exc}"
-    return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
+    # one shared classifier (resilience.retry.is_oom) behind every OOM
+    # ladder; imported lazily so `import bench` stays jax-free
+    from swiftly_tpu.resilience.retry import is_oom
+
+    return is_oom(exc)
 
 
 def _shrink_streamed_plan(fwd, extra, fold_group=None) -> bool:
@@ -1563,6 +1566,465 @@ def serve_bench(smoke_mode=False):
     return 0 if not problems else 1
 
 
+def _lat_quantile_ms(latencies_s, q):
+    """Latency quantile in ms over a list of seconds-samples."""
+    if not latencies_s:
+        return 0.0
+    lat = sorted(latencies_s)
+    return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 3)
+
+
+def fleet_bench(smoke_mode=False):
+    """`bench.py --fleet [--smoke]`: the self-healing serve-fleet drill.
+
+    Runs ``BENCH_FLEET_REPLICAS`` (default 3) `SubgridService` replicas
+    — threads, one prepared forward each, one simulated chip per
+    replica — behind the `swiftly_tpu.serve.ServeFleet` rendezvous
+    column router with health leases and per-replica circuit breakers,
+    and replays the SAME zipf-over-columns workload through four
+    phases:
+
+    1. **before** — a clean window; its p99 is the recovery baseline;
+    2. **kill** — the same workload submitted as a burst, then a
+       deterministic ``fleet.replica.kill`` fault (`WorkerKilled` in a
+       replica pump — simulated chip death) lands mid-stream: the
+       victim's lease misses beats → suspect → probe fails → revoked;
+       its breaker trips open; its queued + in-flight requests fail
+       over to the survivors with the backoff ladder (laggards past
+       the p99 budget are hedged). ZERO requests may be lost;
+    3. **after** — the victim is restored (fresh pump over its warm
+       forward); the breaker goes half-open, probe requests close it,
+       and the window's p99 must recover to <= 1.5x the *before* p99;
+    4. **overload** — injected ``fleet.route`` faults are survived by
+       the route retry, then the brownout ladder is drilled with a
+       forced queue-share signal: rung 1 sheds priority-0 submissions
+       with a structured ``retry_after_s``, rung 2 degrades every
+       replica to per-request dispatch, then hysteresis steps back
+       down. (The signal is forced so the drill is deterministic; the
+       organic signal path is pinned by tests/test_fleet.py.)
+
+    Every served result is audited BIT-IDENTICAL against per-request
+    `get_subgrid_task` on a fresh forward — failover and hedging must
+    never change an answer. The artifact's ``fleet`` block (validated
+    by `obs.validate_fleet_artifact`) records per-replica QPS, the
+    failover/hedge/brownout counters, the victim's full breaker cycle
+    and the p99 before/during/after windows; with ``--smoke`` the
+    drill outcomes are asserted and the leg exits nonzero on any
+    problem (wired into tier-1 via tests/test_bench_smoke.py).
+    """
+    import jax
+
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        SwiftlyForward,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.models import SWIFT_CONFIGS
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_fleet_artifact,
+    )
+    from swiftly_tpu.resilience import FaultPlan, faults
+    from swiftly_tpu.serve import (
+        AdmissionQueue,
+        CoalescingScheduler,
+        ServeFleet,
+        SubgridService,
+    )
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    out_path = os.environ.get("BENCH_FLEET_OUT", "BENCH_fleet.json")
+    if smoke_mode:
+        os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+        metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get("BENCH_FLEET_CONFIG", "1k[1]-n512-256")
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    per_phase = int(os.environ.get("BENCH_FLEET_PHASE_REQUESTS", "72"))
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "1234"))
+    zipf_s = float(os.environ.get("BENCH_FLEET_ZIPF_S", "1.1"))
+    max_depth = int(os.environ.get("BENCH_FLEET_DEPTH", "256"))
+    max_batch = int(os.environ.get("BENCH_FLEET_MAX_BATCH", "16"))
+
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    dtype = jax.numpy.float32
+    platform = jax.devices()[0].platform
+    config = SwiftlyConfig(backend="planar", dtype=dtype, **params)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    sources = _bench_sources(config.image_size)
+    # ONE facet data set, N independent prepared forwards (replica =
+    # simulated chip: own facet upload, own column LRU, own queue); the
+    # in-process + persistent XLA caches make the repeat compiles cheap
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, sources))
+        for fc in facet_configs
+    ]
+
+    def replica_factory(rid):
+        fwd = SwiftlyForward(
+            config, facet_tasks, lru_forward=2, queue_size=64
+        )
+        return SubgridService(
+            fwd,
+            queue=AdmissionQueue(max_depth=max_depth),
+            scheduler=CoalescingScheduler(max_batch=max_batch),
+            max_retries=2,
+        )
+
+    fleet = ServeFleet(
+        replica_factory, n_replicas,
+        lease_interval_s=0.02, miss_suspect=3, miss_revoke=6,
+        breaker_threshold=3, breaker_reopen_s=0.3,
+        breaker_max_reopen_s=4.0, half_open_probes=2,
+        hedge_min_s=0.05,
+        # brownout is drilled explicitly in the overload phase; an
+        # impossible share keeps it out of the kill/recovery windows
+        brownout_share=2.0, brownout_min_depth=8,
+        brownout_escalate_s=0.1,
+        failover_backoff_s=0.01, seed=seed,
+    )
+
+    # one shared workload per phase (same seed -> identical request
+    # multiset), so the before/during/after p99 windows are comparable
+    workload, hot_off0 = _zipf_workload(
+        subgrid_configs, per_phase, seed, zipf_s
+    )
+    # move the bucket-shape compiles AND the per-replica lazy facet
+    # preparation off every phase's latency path (each replica's
+    # forward prepares its facet stack on first dispatch — unwarmed,
+    # that lands in the *before* window and poisons the p99 baseline)
+    hot_col = [sg for sg in subgrid_configs if sg.off0 == hot_off0]
+    for replica in fleet.replicas.values():
+        warm_fwd = replica.service.fwd
+        b = 1
+        while b <= max_batch:
+            warm_fwd.get_subgrid_tasks([hot_col[0]] * b)
+            b *= 2
+        warm_fwd.get_subgrid_task(hot_col[0])
+
+    from swiftly_tpu.obs import trace as otrace
+
+    fleet_span = otrace.span("bench.fleet", cat="bench", config=name)
+    t0 = time.time()
+    fleet_span.__enter__()
+    fleet.start()
+    tracked = []
+
+    def run_phase(label, drain_timeout=180.0):
+        phase = []
+        for sg in workload:
+            fr = fleet.submit(sg, priority=1)
+            phase.append((sg, fr))
+            tracked.append((sg, fr))
+        if not fleet.drain(timeout=drain_timeout):
+            log.error("phase %s did not drain", label)
+        oks = [
+            fr.result.latency_s
+            for _sg, fr in phase
+            if fr.result is not None and fr.result.ok
+        ]
+        return phase, oks
+
+    # -- phase 1: the clean baseline window -------------------------------
+    _phase_a, lat_before = run_phase("before")
+    p99_before = _lat_quantile_ms(lat_before, 0.99)
+
+    # -- phase 2: kill mid-workload ---------------------------------------
+    # burst FIRST so every replica holds queued work, THEN arm the
+    # deterministic kill: the 4th fleet.replica.kill site call after
+    # install (every replica pump iterates the shared site) raises
+    # WorkerKilled in whichever pump reaches it — the drill is
+    # victim-agnostic by design (any of the N must fail over cleanly,
+    # with its queued + in-flight burst share stranded mid-serve)
+    kill_plan = FaultPlan(
+        [{"site": "fleet.replica.kill", "kind": "kill", "at": 3}],
+        seed=seed,
+    )
+    phase_b = []
+    for sg in workload:
+        fr = fleet.submit(sg, priority=1)
+        phase_b.append((sg, fr))
+        tracked.append((sg, fr))
+    with faults.active(kill_plan):
+        if not fleet.drain(timeout=300.0):
+            log.error("kill phase did not drain")
+    lat_during = [
+        fr.result.latency_s
+        for _sg, fr in phase_b
+        if fr.result is not None and fr.result.ok
+    ]
+    p99_during = _lat_quantile_ms(lat_during, 0.99)
+    victims = [
+        rid for rid, r in fleet.replicas.items() if r.dead
+    ]
+    victim = victims[0] if victims else None
+
+    # -- phase 3: restore + recovery window -------------------------------
+    if victim is not None:
+        fleet.restore_replica(victim)
+    _phase_c, lat_after = run_phase("after")
+    p99_after = _lat_quantile_ms(lat_after, 0.99)
+    # drive the victim's breaker through half-open probes to closed:
+    # keep offering its preferred columns until the cycle completes
+    if victim is not None:
+        victim_cols = [
+            sg for sg in subgrid_configs
+            if fleet.preferred_replica(sg.off0) == victim
+        ] or hot_col
+        deadline = time.time() + 10.0
+        i = 0
+        while (
+            fleet.replica(victim).breaker.state != "closed"
+            and time.time() < deadline
+        ):
+            sg = victim_cols[i % len(victim_cols)]
+            i += 1
+            fr = fleet.submit(sg, priority=1)
+            tracked.append((sg, fr))
+            fleet.drain(timeout=30.0)
+            time.sleep(0.02)
+
+    # -- phase 4: overload — route faults + the brownout ladder -----------
+    route_plan = FaultPlan(
+        [{"site": "fleet.route", "kind": "ioerror", "every": 3,
+          "times": 4}],
+        seed=seed,
+    )
+    with faults.active(route_plan):
+        for sg in workload[:24]:
+            fr = fleet.submit(sg, priority=1)
+            tracked.append((sg, fr))
+        fleet.drain(timeout=60.0)
+    # brownout: force the journey queue-share signal (deterministic
+    # drill of the LADDER; the organic signal path is unit-tested) and
+    # shed a priority-0 burst at the door
+    fleet.queue_share = lambda window=256: 0.95  # instance override
+    fleet.brownout_min_depth = 0
+    fleet.brownout_share = 0.5
+    deadline = time.time() + 5.0
+    while fleet.brownout_level < 1 and time.time() < deadline:
+        time.sleep(0.005)
+    brownout_shed = [
+        fleet.submit(sg, priority=0) for sg in workload[:12]
+    ]
+    while fleet.brownout_level < 2 and time.time() < deadline:
+        time.sleep(0.005)
+    level_max = fleet.brownout_level
+    per_request_dispatch = all(
+        r.service.scheduler.max_batch == 1
+        for r in fleet.replicas.values()
+    ) if level_max >= 2 else False
+    # restore the organic signal AND the impossible threshold so the
+    # step-down path is deterministic (hysteresis walks 2 -> 1 -> 0)
+    del fleet.queue_share
+    fleet.brownout_share = 2.0
+    fleet.brownout_min_depth = 8
+    deadline = time.time() + 5.0
+    while fleet.brownout_level > 0 and time.time() < deadline:
+        time.sleep(0.005)
+    batch_restored = all(
+        r.service.scheduler.max_batch == max_batch
+        for r in fleet.replicas.values()
+    )
+
+    fleet.drain(timeout=60.0)
+    wall = time.time() - t0
+    stats = fleet.stats(wall_s=wall)
+    fleet.stop()
+    fleet_span.__exit__(None, None, None)
+
+    # -- bit-identity audit: every served result vs per-request compute
+    # on a FRESH forward — failover/hedging must never change answers
+    fwd_ref = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=64)
+    ref_cache = {}
+    checked = mismatches = 0
+    for sg, fr in tracked:
+        res = fr.result
+        if res is None or not res.ok:
+            continue
+        key = (sg.off0, sg.off1)
+        if key not in ref_cache:
+            ref_cache[key] = np.asarray(fwd_ref.get_subgrid_task(sg))
+        checked += 1
+        if not np.array_equal(np.asarray(res.data), ref_cache[key]):
+            mismatches += 1
+
+    n_ok = sum(
+        1 for _sg, fr in tracked
+        if fr.result is not None and fr.result.ok
+    )
+    zero_lost = n_ok == len(tracked)
+    victim_cycle = (
+        [t["to"] for t in stats["breakers"][str(victim)]["transitions"]]
+        if victim is not None else []
+    )
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    shed_hints = [
+        r.result.retry_after_s
+        for r in brownout_shed
+        if r.result is not None and r.result.retry_after_s is not None
+    ]
+    record = {
+        "metric": (
+            f"{name} self-healing serve fleet "
+            f"({len(tracked)} zipf requests over {n_cols} columns, "
+            f"{n_replicas} replicas, kill+restore drill, planar f32, "
+            f"{platform})"
+        ),
+        "value": round(wall, 4),
+        "unit": "s",
+        "throughput_rps": (
+            round(stats["served"] / wall, 2) if wall else 0.0
+        ),
+        "p50_ms": stats["p50_ms"],
+        "p99_ms": stats["p99_ms"],
+        "n_requests": stats["requests"],
+        "n_served": stats["served"],
+        "n_shed": stats["shed"],
+        "bit_identical": {"checked": checked, "mismatches": mismatches},
+        "fleet": {
+            "n_replicas": n_replicas,
+            "victim": victim,
+            "replica_deaths": len(victims),
+            "restores": stats["restores"],
+            "failovers": stats["failovers"],
+            "reroutes": stats["reroutes"],
+            "hedges": stats["hedges"],
+            "hedge_wins": stats["hedge_wins"],
+            "route_faults": stats["route_faults"],
+            "zero_lost": zero_lost,
+            "p99_before_ms": p99_before,
+            "p99_during_ms": p99_during,
+            "p99_after_ms": p99_after,
+            "p99_recovery_ratio": (
+                round(p99_after / p99_before, 3) if p99_before else None
+            ),
+            "breaker_cycle": victim_cycle,
+            "breakers": stats["breakers"],
+            "health_transitions": stats["health"]["transitions"],
+            "zombie_beats": stats["health"]["zombie_beats"],
+            "brownout": {
+                **stats["brownout"],
+                "level_max": level_max,
+                "per_request_dispatch": per_request_dispatch,
+                "batch_restored": batch_restored,
+                "retry_after_hints": [
+                    round(h, 4) for h in shed_hints[:8]
+                ],
+            },
+            "per_replica": stats["per_replica"],
+        },
+        "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
+        "n_subgrids_cover": len(subgrid_configs),
+        "manifest": run_manifest(
+            params={"config": name, "mode": "fleet", **params},
+        ),
+    }
+    if metrics.enabled():
+        record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+
+        summary = summarize_trace(
+            otrace.export(), root_id=getattr(fleet_span, "id", None)
+        )
+        summary["leg_wall_s"] = round(wall, 6)
+        record["trace"] = summary
+        otrace.save(trace_path)
+        otrace.disable()
+
+    problems = validate_fleet_artifact(record)
+    if smoke_mode:
+        # drill outcomes: the schema passing is not proof the fleet
+        # actually healed
+        if len(victims) != 1:
+            problems.append(
+                f"expected exactly 1 replica death, got {victims}"
+            )
+        if not zero_lost:
+            problems.append(
+                f"lost requests: {len(tracked) - n_ok} of "
+                f"{len(tracked)} not served"
+            )
+        if mismatches or checked != n_ok:
+            problems.append(
+                f"bit-identity audit failed: {mismatches} mismatches, "
+                f"{checked}/{n_ok} checked"
+            )
+        if stats["failovers"] < 1:
+            problems.append("the kill produced no failover")
+        for state in ("open", "half_open", "closed"):
+            if state not in victim_cycle:
+                problems.append(
+                    f"victim breaker never reached {state!r} "
+                    f"(cycle: {victim_cycle})"
+                )
+        if p99_before and p99_after > 1.5 * p99_before:
+            problems.append(
+                f"p99 did not recover: {p99_after}ms after vs "
+                f"{p99_before}ms before (> 1.5x)"
+            )
+        if not any(
+            h["owner"] == victim and h["to"] == "revoked"
+            for h in stats["health"]["transitions"]
+        ):
+            problems.append("victim lease was never revoked")
+        if stats["route_faults"] < 1:
+            problems.append(
+                "injected fleet.route faults never fired/retried"
+            )
+        if stats["brownout"]["sheds"] < 1 or not shed_hints:
+            problems.append(
+                "brownout rung 1 shed nothing (or sheds carried no "
+                "retry_after_s hint)"
+            )
+        if level_max < 2 or not per_request_dispatch:
+            problems.append(
+                f"brownout never reached per-request dispatch "
+                f"(level_max={level_max})"
+            )
+        if not batch_restored:
+            problems.append(
+                "brownout recovery did not restore max_batch"
+            )
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2)
+    if smoke_mode:
+        metrics.disable()
+        print(
+            json.dumps(
+                {
+                    "fleet_smoke": "ok" if not problems else "failed",
+                    "config": name,
+                    "artifact": out_path,
+                    "n_served": stats["served"],
+                    "victim": victim,
+                    "failovers": stats["failovers"],
+                    "p99_before_ms": p99_before,
+                    "p99_after_ms": p99_after,
+                    "breaker_cycle": victim_cycle,
+                    "problems": problems,
+                }
+            ),
+            flush=True,
+        )
+        return 0 if not problems else 1
+    print(json.dumps(record), flush=True)
+    return 0 if not problems else 1
+
+
 def smoke():
     """Fast schema-validation leg (`bench.py --smoke`, wired into the
     tier-1 tests): run the 1k round trip with telemetry ON, write the
@@ -2018,6 +2480,8 @@ def main():
 
     if "--serve" in sys.argv:
         sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
+    if "--fleet" in sys.argv:
+        sys.exit(fleet_bench(smoke_mode="--smoke" in sys.argv))
     if "--chaos" in sys.argv:
         sys.exit(chaos(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:

@@ -12,7 +12,13 @@ artifact) and exits non-zero when a leg regressed:
   ``--threshold`` (default 20%) SLOWER than the best reference for the
   same (config, mode);
 * **MFU** — latest ``mfu_pct`` more than the threshold BELOW the best
-  reference.
+  reference;
+* **p99 / QPS** — for serving legs (``--serve`` / ``--fleet``
+  artifacts): latest ``p99_ms`` more than the threshold above the best
+  (lowest) reference p99, or ``throughput_rps`` more than the
+  threshold below the best (highest) reference — a serve-fleet tail
+  latency or capacity regression trips the sentinel exactly like a
+  batch-leg wall regression.
 
 Legs are matched by (config, mode) — taken from the stamped
 ``manifest.config_params`` when present (every record since PR 1),
@@ -106,7 +112,9 @@ def compare(latest_records, reference_records, threshold=0.2):
         if key is None or rec.get("skipped") or rec.get("error"):
             continue
         bucket = refs.setdefault(
-            (key, leg_platform(rec)), {"wall": None, "mfu": None, "n": 0}
+            (key, leg_platform(rec)),
+            {"wall": None, "mfu": None, "p99": None, "rps": None,
+             "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -117,6 +125,14 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(mfu, (int, float)):
             if bucket["mfu"] is None or mfu > bucket["mfu"]:
                 bucket["mfu"] = mfu
+        p99 = rec.get("p99_ms")
+        if isinstance(p99, (int, float)) and p99 > 0:
+            if bucket["p99"] is None or p99 < bucket["p99"]:
+                bucket["p99"] = p99
+        rps = rec.get("throughput_rps")
+        if isinstance(rps, (int, float)) and rps > 0:
+            if bucket["rps"] is None or rps > bucket["rps"]:
+                bucket["rps"] = rps
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -170,6 +186,34 @@ def compare(latest_records, reference_records, threshold=0.2):
                 f"{100 * (1 - mfu / ref['mfu']):.1f}% below best "
                 f"reference {ref['mfu']:.4g}%"
             )
+        # serving legs (serve/fleet): tail latency + capacity sentinel
+        p99 = rec.get("p99_ms")
+        if isinstance(p99, (int, float)) and p99 > 0:
+            verdict["p99_ms"] = p99
+            verdict["ref_p99_ms"] = ref["p99"]
+            if (
+                ref["p99"] is not None
+                and p99 > ref["p99"] * (1.0 + threshold)
+            ):
+                verdict["problems"].append(
+                    f"p99 {p99:.4g}ms is "
+                    f"{100 * (p99 / ref['p99'] - 1):.1f}% above best "
+                    f"reference {ref['p99']:.4g}ms "
+                    f"(threshold {100 * threshold:.0f}%)"
+                )
+        rps = rec.get("throughput_rps")
+        if isinstance(rps, (int, float)) and rps > 0:
+            verdict["throughput_rps"] = rps
+            verdict["ref_throughput_rps"] = ref["rps"]
+            if (
+                ref["rps"] is not None
+                and rps < ref["rps"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"throughput {rps:.4g} rps is "
+                    f"{100 * (1 - rps / ref['rps']):.1f}% below best "
+                    f"reference {ref['rps']:.4g} rps"
+                )
         legs.append(verdict)
         if verdict["problems"]:
             regressions.append(verdict)

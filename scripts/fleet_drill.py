@@ -1,0 +1,90 @@
+"""Fleet drill CLI: kill-and-restore a serve replica under live load
+and verify the fleet healed itself without losing a request.
+
+The operator's front door to the self-healing serve fleet
+(docs/serving.md): runs `bench.fleet_bench` — N `SubgridService`
+replicas behind the rendezvous column router with health leases and
+per-replica circuit breakers, a zipf workload replayed through four
+phases (clean baseline, mid-workload `WorkerKilled` with zero-loss
+failover, restore with the breaker's half-open → closed recovery, and
+the overload drill: injected route faults + the brownout ladder) —
+stamps the schema-validated ``fleet`` block into a BENCH-style
+artifact, and exits nonzero unless every request completed, results
+stayed bit-identical, the breaker cycled, and p99 recovered.
+
+Usage:
+    python scripts/fleet_drill.py                        # 1k, 3 replicas
+    python scripts/fleet_drill.py --replicas 4 --requests 120
+    python scripts/fleet_drill.py --swift_config 4k[1]-n2k-512
+
+The artifact's ``fleet`` block records per-replica QPS, failover /
+hedge / brownout counters, the victim's breaker transitions and the
+p99 before/during/after windows — `scripts/bench_compare.py` sentinels
+the p99/QPS numbers against prior fleet artifacts.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="kill-and-restore fleet drill over replicated "
+        "subgrid serving (health leases + circuit breakers + zero-loss "
+        "failover + brownout)"
+    )
+    ap.add_argument("--swift_config", default="1k[1]-n512-256",
+                    help="catalogue config name (default 1k smoke scale)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="fleet size (default 3)")
+    ap.add_argument("--requests", type=int, default=72,
+                    help="zipf requests per drill phase (default 72)")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--out", default="BENCH_fleet.json",
+                    help="artifact path (default BENCH_fleet.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the drill outcomes (nonzero exit on "
+                    "any unhealed failure), not just the schema")
+    ap.add_argument("--loglevel", default="INFO")
+    args = ap.parse_args()
+
+    logging.basicConfig(
+        level=args.loglevel,
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    os.environ["BENCH_FLEET_OUT"] = args.out
+    os.environ["BENCH_FLEET_CONFIG"] = args.swift_config
+    os.environ["BENCH_FLEET_REPLICAS"] = str(args.replicas)
+    os.environ["BENCH_FLEET_PHASE_REQUESTS"] = str(args.requests)
+    os.environ["BENCH_FLEET_SEED"] = str(args.seed)
+
+    import bench
+
+    # fleet_bench owns metrics enablement, artifact stamping, schema
+    # validation and the summary line; the CLI just parameterises it
+    rc = bench.fleet_bench(smoke_mode=args.smoke)
+    if rc == 0:
+        log = logging.getLogger("fleet-drill")
+        with open(args.out) as fh:
+            fl = json.load(fh)["fleet"]
+        log.info(
+            "fleet healed: replica %s killed+restored, %d failover(s), "
+            "%d hedge(s), breaker %s, p99 %.1fms -> %.1fms -> %.1fms, "
+            "zero_lost=%s",
+            fl["victim"], fl["failovers"], fl["hedges"],
+            "->".join(fl["breaker_cycle"]) or "n/a",
+            fl["p99_before_ms"], fl["p99_during_ms"],
+            fl["p99_after_ms"], fl["zero_lost"],
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,7 @@ from .heartbeat import Heartbeat, PartialArtifactWriter
 from .manifest import (
     run_manifest,
     validate_artifact,
+    validate_fleet_artifact,
     validate_resilience_artifact,
     validate_serve_artifact,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "summarize_trace",
     "trace",
     "validate_artifact",
+    "validate_fleet_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
     "validate_trace_artifact",

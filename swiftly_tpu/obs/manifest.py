@@ -27,12 +27,14 @@ import time
 
 __all__ = [
     "BASELINE_SOURCES",
+    "FLEET_ARTIFACT_FIELDS",
     "MANIFEST_SCHEMA",
     "RESILIENCE_ARTIFACT_FIELDS",
     "SERVE_ARTIFACT_FIELDS",
     "config_hash",
     "run_manifest",
     "validate_artifact",
+    "validate_fleet_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
 ]
@@ -263,6 +265,123 @@ def validate_serve_artifact(record):
             problems.append(
                 f"journey segment shares sum to {sum(shares)}, not 1"
             )
+    return problems
+
+
+# The fleet block every `bench.py --fleet` artifact must carry — the
+# self-healing serve drill's schema contract: the kill/restore cycle
+# (replica deaths, failovers, restores), the full breaker cycle, the
+# p99 before/during/after windows, and zero-loss + bit-identity.
+FLEET_ARTIFACT_FIELDS = (
+    "p50_ms",
+    "p99_ms",
+    "throughput_rps",
+    "n_requests",
+    "n_served",
+)
+
+_FLEET_BLOCK_FIELDS = (
+    "n_replicas",
+    "failovers",
+    "replica_deaths",
+    "restores",
+    "zero_lost",
+    "p99_before_ms",
+    "p99_during_ms",
+    "p99_after_ms",
+    "breaker_cycle",
+    "per_replica",
+    "brownout",
+    "health_transitions",
+)
+
+
+def validate_fleet_artifact(record):
+    """Problems with a fleet-mode BENCH artifact, as a list of strings.
+
+    Fleet legs carry no numpy baseline (nothing is raced) but must
+    carry the full manifest, the fleet-wide latency/QPS block, and a
+    coherent ``fleet`` drill block: at least one replica killed and
+    restored, its breaker showing the full open → half-open → closed
+    cycle, a per-replica QPS table covering the whole fleet,
+    ``zero_lost`` True and a clean bit-identity audit — a failover
+    drill that dropped or corrupted a request is a correctness bug,
+    not an availability result.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    for field in FLEET_ARTIFACT_FIELDS:
+        if field not in record:
+            problems.append(f"missing fleet field {field!r}")
+    p50, p99 = record.get("p50_ms"), record.get("p99_ms")
+    if (
+        isinstance(p50, (int, float))
+        and isinstance(p99, (int, float))
+        and p99 < p50
+    ):
+        problems.append(f"p99_ms {p99} < p50_ms {p50}")
+    bit = record.get("bit_identical")
+    if not isinstance(bit, dict) or not (
+        {"checked", "mismatches"} <= set(bit)
+    ):
+        problems.append(
+            "missing bit_identical {checked, mismatches} block"
+        )
+    elif bit["mismatches"]:
+        problems.append(
+            f"bit-identity audit failed: {bit['mismatches']} "
+            f"mismatch(es) in {bit['checked']} checked"
+        )
+    fleet = record.get("fleet")
+    if not isinstance(fleet, dict):
+        problems.append("missing fleet block")
+        return problems
+    for field in _FLEET_BLOCK_FIELDS:
+        if field not in fleet:
+            problems.append(f"fleet block missing {field!r}")
+    n = fleet.get("n_replicas")
+    if isinstance(n, int) and n < 2:
+        problems.append(
+            f"n_replicas {n} < 2 (a one-replica fleet cannot fail over)"
+        )
+    if isinstance(fleet.get("replica_deaths"), int):
+        if fleet["replica_deaths"] < 1:
+            problems.append("fleet drill killed no replica")
+    if isinstance(fleet.get("restores"), int) and fleet["restores"] < 1:
+        problems.append("fleet drill restored no replica")
+    if fleet.get("zero_lost") is not True:
+        problems.append(
+            f"zero_lost is {fleet.get('zero_lost')!r}: the drill must "
+            "complete every admitted request"
+        )
+    cycle = fleet.get("breaker_cycle")
+    if isinstance(cycle, list):
+        missing = {"open", "half_open", "closed"} - set(cycle)
+        if missing:
+            problems.append(
+                f"breaker cycle {cycle} missing state(s) "
+                f"{sorted(missing)} — the victim's breaker must open, "
+                "half-open and close in the artifact"
+            )
+    per = fleet.get("per_replica")
+    if isinstance(per, list):
+        if isinstance(n, int) and len(per) != n:
+            problems.append(
+                f"per_replica has {len(per)} row(s) for {n} replicas"
+            )
+        for row in per:
+            if not isinstance(row, dict) or not (
+                {"id", "served", "qps"} <= set(row)
+            ):
+                problems.append(
+                    "per_replica rows need {id, served, qps}"
+                )
+                break
+    for field in ("p99_before_ms", "p99_during_ms", "p99_after_ms"):
+        v = fleet.get(field)
+        if v is not None and (
+            not isinstance(v, (int, float)) or v < 0
+        ):
+            problems.append(f"{field} {v!r} is not a latency")
     return problems
 
 

@@ -11,7 +11,11 @@ package is the discipline layer:
   serve dispatch); zero-cost no-op when no plan is installed.
 * ``resilience.retry``   — the shared `retry_transient` wrapper:
   transient-vs-fatal classification + jittered exponential backoff,
-  accounted via `obs.metrics` (``retry.*`` counters).
+  accounted via `obs.metrics` (``retry.*`` counters); `is_oom` is the
+  one allocator-failure classifier every OOM ladder shares.
+* ``resilience.breaker`` — the per-dependency `CircuitBreaker`
+  (closed → open on consecutive failures, half-open probes, jittered
+  escalating reopen) the serve fleet gates each replica with.
 * ``resilience.degrade`` — the graceful-degradation ledger every
   ladder step (spill disk -> RAM -> replay; corrupt checkpoint ->
   previous generation; fused batch -> split -> per-request) records
@@ -24,6 +28,7 @@ CRC32, keep-N generation rotation with automatic fallback) lives in
 """
 
 from . import degrade
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .faults import (
     FaultError,
     FaultPlan,
@@ -35,18 +40,23 @@ from .faults import (
     plan_from_env,
     uninstall,
 )
-from .retry import backoff_delay, is_transient, retry_transient
+from .retry import backoff_delay, is_oom, is_transient, retry_transient
 
 __all__ = [
+    "CLOSED",
+    "CircuitBreaker",
     "FaultError",
     "FaultPlan",
+    "HALF_OPEN",
     "InjectedResourceExhausted",
+    "OPEN",
     "WorkerKilled",
     "active",
     "backoff_delay",
     "degrade",
     "fault_point",
     "install",
+    "is_oom",
     "is_transient",
     "plan_from_env",
     "retry_transient",

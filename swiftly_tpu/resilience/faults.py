@@ -41,7 +41,10 @@ in ``plan.injected`` for the resilience artifact block.
 Known sites (see docs/resilience.md for the full table):
 ``spill.write``, ``spill.read``, ``spill.get_row``, ``transfer.h2d``,
 ``transfer.d2h``, ``checkpoint.save``, ``checkpoint.save.done``,
-``checkpoint.restore``, ``serve.dispatch``, ``bwd.feed``.
+``checkpoint.restore``, ``serve.dispatch``, ``bwd.feed``,
+``fleet.replica.kill`` (every replica pump iteration — ``kill`` here
+is simulated chip death), ``fleet.health.probe`` (each active health
+probe), ``fleet.route`` (every fleet routing decision).
 """
 
 from __future__ import annotations

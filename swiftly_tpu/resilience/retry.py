@@ -33,8 +33,10 @@ import time
 from ..obs import metrics as _metrics
 
 __all__ = [
+    "OOM_MARKERS",
     "TRANSIENT_MARKERS",
     "backoff_delay",
+    "is_oom",
     "is_transient",
     "max_retry_attempts",
     "retry_transient",
@@ -51,6 +53,11 @@ TRANSIENT_MARKERS = (
     "CANCELLED",
     "temporarily unavailable",
 )
+
+# Allocator-failure markers (XLA/PJRT surface OOMs as RuntimeError
+# text too). Shared by every OOM ladder — bench's plan shrinker and the
+# serve batch splitter classify with ONE rule instead of private forks.
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory")
 
 _rng = random.Random()
 
@@ -71,6 +78,23 @@ def is_transient(exc) -> bool:
         return True
     text = f"{type(exc).__name__}: {exc}"
     return any(marker in text for marker in TRANSIENT_MARKERS)
+
+
+def is_oom(exc) -> bool:
+    """Is this an allocator failure (device or host out-of-memory)?
+
+    The one classifier behind every OOM degradation ladder (bench's
+    streamed-plan shrinker, serve's batch splitter): an exception whose
+    type or message carries an ``OOM_MARKERS`` entry, e.g. XLA's
+    ``RESOURCE_EXHAUSTED`` status or a Python ``MemoryError``.
+    """
+    if isinstance(exc, MemoryError):
+        return True
+    text = f"{type(exc).__name__}: {exc}"
+    lower = text.lower()
+    return any(
+        m in text or m.lower() in lower for m in OOM_MARKERS
+    )
 
 
 def backoff_delay(attempt, base_s=0.05, max_s=2.0, rng=None):

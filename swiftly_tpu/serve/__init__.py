@@ -17,7 +17,15 @@ arXiv:2002.03260 applied to ragged demand):
   prepared `SwiftlyForward` (+ optional recorded-stream cache feed),
   enforces per-request timeouts, isolates and retries batch failures,
   quarantines poisoned requests, and exports latency SLO metrics
-  (p50/p99, shed rate, coalesce-hit rate) through ``obs``.
+  (p50/p99, shed rate, coalesce-hit rate) through ``obs``;
+* `serve.health` — heartbeat `HealthLease` per replica plus the
+  `HealthMonitor` that grades them (live → suspect → revoked, with
+  active probes through the ``fleet.health.probe`` fault site);
+* `serve.fleet.ServeFleet` — N supervised service replicas behind a
+  rendezvous-hashed column router with per-replica circuit breakers
+  (`resilience.breaker`), zero-loss failover, journey-driven brownout
+  and hedged sends — the self-healing serve fleet ``bench.py --fleet``
+  drills.
 
 Entry points: build a `SwiftlyForward`, wrap it in a `SubgridService`,
 then ``submit(config).wait()`` (worker-thread mode via ``start()``) or
@@ -26,6 +34,14 @@ replays a zipf-over-columns workload through this stack and stamps the
 SLO block into its artifact. See docs/serving.md.
 """
 
+from .fleet import FleetRequest, Replica, ServeFleet
+from .health import (
+    LIVE,
+    REVOKED,
+    SUSPECT,
+    HealthLease,
+    HealthMonitor,
+)
 from .queue import (
     STATUS_EXPIRED,
     STATUS_OK,
@@ -45,9 +61,17 @@ from .service import (
 __all__ = [
     "AdmissionQueue",
     "CoalescingScheduler",
+    "FleetRequest",
+    "HealthLease",
+    "HealthMonitor",
+    "LIVE",
+    "Replica",
     "RequestResult",
+    "REVOKED",
+    "ServeFleet",
     "SubgridRequest",
     "SubgridService",
+    "SUSPECT",
     "STATUS_EXPIRED",
     "STATUS_OK",
     "STATUS_QUARANTINED",

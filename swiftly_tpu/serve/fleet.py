@@ -1,0 +1,899 @@
+"""`ServeFleet`: a self-healing fleet of `SubgridService` replicas.
+
+PR 3's service is one in-process server; its death loses every
+in-flight request, and one server is one chip's throughput. The fleet
+runs **N replicas** (threads, one per simulated chip — each over its
+own prepared forward, the way one process owns one TPU in the
+DaggerFFT/TPU-DFT deployments, arXiv 2601.12209 / 2002.03260) behind a
+routing front, supervised so that replica death is an *absorbed* event:
+
+* **Routing** — rendezvous (highest-random-weight) hashing of the
+  subgrid column ``off0`` over the live replicas. Stable by
+  construction: each column has one preferred replica (whose column
+  LRU therefore stays hot for it), a dead replica's columns
+  redistribute over the survivors without disturbing anyone else's
+  assignment, and they return when it is restored. Every routing
+  decision passes the ``fleet.route`` fault site (injected route
+  faults are retried with the PR-4 backoff).
+* **Health** — each replica's pump loop beats a `HealthLease`
+  (`serve.health`); the supervisor grades leases every tick, probes
+  suspects (``fleet.health.probe`` site), and **revokes** dead ones.
+  A revoked replica's lease latches: zombie beats are ignored until an
+  explicit restore.
+* **Circuit breakers** — one `resilience.breaker.CircuitBreaker` per
+  replica. Lease revocation trips it open (and consecutive request
+  failures open it the classic way); while open the router skips the
+  replica; after the jittered reopen delay, half-open probe requests
+  flow and their successes close it.
+* **Zero-loss failover** — the fleet keeps a ledger of every admitted
+  request. When a replica dies (its pump raises `WorkerKilled` — the
+  ``fleet.replica.kill`` site — or its lease is revoked), the
+  supervisor re-routes its queued *and* in-flight requests to
+  survivors with the PR-4 jittered backoff ladder between attempts.
+  Results are bit-identical wherever they run (the engine is
+  deterministic), and an admitted deadline-less request is never
+  dropped — admission is the only door that sheds.
+* **Brownout** — fleet-wide overload policy driven by the PR-5 journey
+  decomposition: when the recent queue-wait share of request latency
+  crosses ``brownout_share`` (requests spend their life waiting, not
+  computing), the fleet steps down a ladder — rung 1 sheds
+  lowest-priority submissions at the door with a structured
+  ``retry_after_s`` hint; rung 2 degrades every replica to per-request
+  dispatch (``max_batch = 1``) so high-priority requests stop queueing
+  behind coalesced batches. Both rungs are recorded in the PR-4
+  degradation ledger and reversed with hysteresis when pressure clears.
+* **Hedged sends** — a request still pending past its p99 budget
+  (``hedge_factor`` x the fleet's rolling p99) is duplicated onto a
+  second replica; the first completion wins (idempotent), the loser is
+  discarded. One hedge per request.
+
+Drive it with ``start()`` (replica pumps + supervisor thread) and
+``submit(...).wait()``, or deterministically with ``tick(now)`` and
+manual service pumps (tests). ``bench.py --fleet`` is the kill/restore
+drill; see docs/serving.md for the architecture walk-through.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+import threading
+import time
+
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..resilience import degrade as _degrade
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.faults import WorkerKilled, fault_point as _fault_point
+from ..resilience.retry import backoff_delay, retry_transient
+from .health import HealthLease, HealthMonitor, REVOKED
+from .queue import (
+    RequestResult,
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_SHED,
+)
+
+__all__ = ["FleetRequest", "Replica", "ServeFleet"]
+
+log = logging.getLogger("swiftly-tpu.fleet")
+
+_FLEET_IDS = itertools.count()
+_LAT_RING = 4096  # newest-wins fleet latency samples for the p99 budget
+
+
+def _rendezvous_score(off0, rid):
+    """Deterministic 32-bit mix of (column, replica) — the
+    highest-random-weight routing score. Pure integer arithmetic:
+    stable across processes and platforms (unlike ``hash()``)."""
+    x = (int(off0) * 0x9E3779B1 ^ (int(rid) + 0x85EBCA6B)) & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x045D9F3B) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+class FleetRequest:
+    """Client-facing handle for one fleet request.
+
+    Survives failover and hedging: the underlying per-replica
+    `SubgridRequest` may be re-issued on another replica, but the
+    client holds ONE handle whose completion is idempotent —
+    the first terminal result wins, later (hedge-loser / zombie)
+    completions are discarded.
+    """
+
+    __slots__ = (
+        "config", "priority", "req_id", "submit_t", "deadline_t",
+        "result", "replica_trail", "_event", "_lock", "_clock",
+    )
+
+    def __init__(self, config, priority=0, deadline_s=None,
+                 clock=time.monotonic):
+        self.config = config
+        self.priority = int(priority)
+        self.req_id = next(_FLEET_IDS)
+        self._clock = clock
+        self.submit_t = clock()
+        self.deadline_t = (
+            None if deadline_s is None
+            else self.submit_t + float(deadline_s)
+        )
+        self.result = None
+        self.replica_trail = []  # rids this request was offered to
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+
+    @property
+    def done(self):
+        return self.result is not None
+
+    def wait(self, timeout=None):
+        """Block until terminal; returns the `RequestResult` (or None
+        on wait timeout)."""
+        self._event.wait(timeout)
+        return self.result
+
+    def _complete(self, result, now=None):
+        """First terminal result wins; returns False for losers."""
+        with self._lock:
+            if self.result is not None:
+                return False
+            now = self._clock() if now is None else now
+            # fleet latency: client submit -> fleet completion (spans
+            # failovers and hedges, not just the winning replica's leg)
+            result.latency_s = max(0.0, now - self.submit_t)
+            self.result = result
+        self._event.set()
+        return True
+
+    def __repr__(self):
+        return (
+            f"FleetRequest(#{self.req_id}, off0={self.config.off0}, "
+            f"off1={self.config.off1}, prio={self.priority})"
+        )
+
+
+class Replica:
+    """One fleet member: a `SubgridService` plus its pump thread,
+    health lease and circuit breaker.
+
+    The pump loop is where simulated chip death lands: every iteration
+    calls the ``fleet.replica.kill`` fault site and honours the
+    `kill()` drill hook; a raised `WorkerKilled` (a BaseException — it
+    tears through like a real SIGKILL) marks the replica dead and ends
+    the thread. The service object and its prepared forward survive,
+    so `restore()` is just a fresh pump thread over warm state.
+    """
+
+    def __init__(self, rid, service, lease, breaker, poll_s=0.001):
+        self.rid = int(rid)
+        self.service = service
+        self.lease = lease
+        self.breaker = breaker
+        self.poll_s = float(poll_s)
+        self.dead = False
+        self._kill_flag = False
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(f"replica {self.rid} already running")
+        self._stop = False
+        self._kill_flag = False
+        self.dead = False
+        trace_ctx = _trace.current()
+        self._thread = threading.Thread(
+            target=self._run, args=(trace_ctx,),
+            name=f"fleet-replica-{self.rid}", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _run(self, trace_ctx=0):
+        _trace.adopt(trace_ctx)
+        try:
+            while not self._stop:
+                if self._kill_flag:
+                    raise WorkerKilled(
+                        f"replica {self.rid} killed (drill hook)"
+                    )
+                self.lease.beat()
+                if len(self.service.queue):
+                    # the kill site fires between "holds pending work"
+                    # and "serves it" — a kill here strands a real
+                    # backlog, the case failover exists for (an idle
+                    # replica's death is trivially lossless and would
+                    # otherwise win every call-indexed schedule, since
+                    # idle pumps spin far faster than serving ones)
+                    _fault_point("fleet.replica.kill")
+                if self.service.pump_once() == 0:
+                    time.sleep(self.poll_s)
+        except WorkerKilled as exc:
+            # simulated chip death: stop beating, leave the queue for
+            # the supervisor's failover sweep
+            self.dead = True
+            _metrics.count("fleet.replica_deaths")
+            _trace.instant("fleet.replica_death", cat="fleet",
+                           replica=self.rid, error=str(exc))
+            log.warning("replica %d died: %s", self.rid, exc)
+
+    def alive(self):
+        return (
+            not self.dead
+            and self._thread is not None
+            and self._thread.is_alive()
+        )
+
+    def kill(self):
+        """Drill hook: the pump raises `WorkerKilled` on its next
+        iteration (equivalent to a ``fleet.replica.kill`` fault)."""
+        self._kill_flag = True
+
+    def stop(self, timeout=5.0):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def restore(self):
+        """Fresh pump thread over the surviving service state (warm
+        forward, warm LRU); the lease/breaker are the caller's to
+        revive."""
+        if self.alive():
+            raise RuntimeError(f"replica {self.rid} is still alive")
+        self._thread = None
+        return self.start()
+
+    def __repr__(self):
+        return (
+            f"Replica({self.rid}, dead={self.dead}, "
+            f"alive={self.alive()})"
+        )
+
+
+class _Entry:
+    """Fleet-side ledger record for one pending request."""
+
+    __slots__ = ("freq", "subs", "reroutes", "not_before", "hedged",
+                 "shed_rids", "shed_hints", "admitted")
+
+    def __init__(self, freq):
+        self.freq = freq
+        self.subs = []         # [(rid, SubgridRequest, is_hedge), ...]
+        self.reroutes = 0
+        self.not_before = 0.0  # backoff gate for the next reroute
+        self.hedged = False
+        self.shed_rids = set()
+        self.shed_hints = []
+        self.admitted = False
+
+
+class ServeFleet:
+    """N supervised `SubgridService` replicas behind one front door.
+
+    :param replica_factory: ``fn(rid) -> SubgridService`` — builds one
+        replica's service (typically over its own prepared forward)
+    :param n_replicas: fleet size
+    :param lease_interval_s / miss_suspect / miss_revoke: heartbeat
+        lease grading (see `serve.health.HealthLease`)
+    :param breaker_threshold / breaker_reopen_s / breaker_max_reopen_s
+        / half_open_probes: per-replica circuit breaker tuning
+    :param hedge_budget_s: age past which a pending request is hedged
+        onto a second replica; None derives it as ``hedge_factor`` x
+        the fleet's rolling p99 (floored at ``hedge_min_s``); 0
+        disables hedging
+    :param brownout_share: recent queue-wait share of latency that
+        triggers the brownout ladder
+    :param brownout_min_depth: total queued requests below which
+        brownout never triggers (an idle fleet has no overload)
+    :param brownout_min_priority: rung-1 sheds submissions with
+        ``priority <`` this floor
+    :param brownout_escalate_s: seconds at rung 1 before rung 2
+        (per-request dispatch)
+    :param failover_backoff_s: base of the jittered backoff ladder
+        between failover reroute attempts
+    :param supervise_interval_s: supervisor thread tick period
+    :param seed: seeds the breakers' reopen jitter (deterministic
+        drills)
+    :param clock: injectable monotonic clock (tests drive `tick(now)`)
+    """
+
+    def __init__(self, replica_factory, n_replicas=3, *,
+                 lease_interval_s=0.05, miss_suspect=2, miss_revoke=5,
+                 breaker_threshold=3, breaker_reopen_s=0.5,
+                 breaker_max_reopen_s=8.0, half_open_probes=2,
+                 hedge_budget_s=None, hedge_factor=2.0, hedge_min_s=0.05,
+                 brownout_share=0.6, brownout_min_depth=8,
+                 brownout_min_priority=1, brownout_escalate_s=0.25,
+                 failover_backoff_s=0.01, failover_backoff_max_s=0.5,
+                 supervise_interval_s=0.002, poll_s=0.001, seed=0,
+                 clock=time.monotonic):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self._clock = clock
+        self.hedge_budget_s = hedge_budget_s
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_s = float(hedge_min_s)
+        self.brownout_share = float(brownout_share)
+        self.brownout_min_depth = int(brownout_min_depth)
+        self.brownout_min_priority = int(brownout_min_priority)
+        self.brownout_escalate_s = float(brownout_escalate_s)
+        self.failover_backoff_s = float(failover_backoff_s)
+        self.failover_backoff_max_s = float(failover_backoff_max_s)
+        self.supervise_interval_s = float(supervise_interval_s)
+        self.monitor = HealthMonitor(probe=self._probe, clock=clock)
+        self._replicas = {}
+        for rid in range(int(n_replicas)):
+            service = replica_factory(rid)
+            lease = HealthLease(
+                owner=f"replica-{rid}", interval_s=lease_interval_s,
+                miss_suspect=miss_suspect, miss_revoke=miss_revoke,
+                clock=clock,
+            )
+            breaker = CircuitBreaker(
+                name=f"replica-{rid}",
+                failure_threshold=breaker_threshold,
+                reopen_s=breaker_reopen_s,
+                max_reopen_s=breaker_max_reopen_s,
+                half_open_probes=half_open_probes,
+                rng=random.Random(seed + rid + 1),
+                clock=clock,
+            )
+            self.monitor.register(rid, lease)
+            self._replicas[rid] = Replica(
+                rid, service, lease, breaker, poll_s=poll_s
+            )
+        self._lock = threading.RLock()
+        self._pending = {}  # freq.req_id -> _Entry
+        self._counts = {
+            "requests": 0, "served": 0, "shed": 0, "expired": 0,
+            "quarantined": 0, "failovers": 0, "reroutes": 0,
+            "hedges": 0, "hedge_wins": 0, "route_faults": 0,
+            "brownout_sheds": 0, "restores": 0,
+        }
+        self._lat = []
+        self._lat_i = 0
+        self._p99_cache = 0.0
+        self._p99_dirty = 0
+        self._brownout_level = 0
+        self._brownout_since = 0.0
+        self._brownout_events = []
+        self._saved_max_batch = {}
+        self._sup_stop = False
+        self._sup_thread = None
+
+    # -- topology ------------------------------------------------------------
+
+    @property
+    def replicas(self):
+        return dict(self._replicas)
+
+    def replica(self, rid):
+        return self._replicas[rid]
+
+    def _probe(self, rid):
+        return self._replicas[rid].alive()
+
+    def preferred_replica(self, off0):
+        """The rendezvous winner for a column over the FULL fleet
+        (health-blind — the router's starting point; drills use it to
+        aim traffic at a specific replica)."""
+        return max(
+            self._replicas,
+            key=lambda rid: _rendezvous_score(off0, rid),
+        )
+
+    # -- routing -------------------------------------------------------------
+
+    def _routable(self, rid, exclude):
+        if rid in exclude:
+            return False
+        replica = self._replicas[rid]
+        return not replica.dead and not replica.lease.revoked
+
+    def _pick(self, off0, exclude, now):
+        """The replica one request routes to, or None (every candidate
+        excluded, revoked, or breaker-denied). Candidates are tried in
+        rendezvous-score order; the breaker gate runs only on actual
+        candidates so half-open probe slots are spent on real sends."""
+        try:
+            retry_transient(
+                lambda: _fault_point("fleet.route"),
+                site="fleet.route", max_attempts=3,
+                base_s=0.001, max_s=0.01,
+                on_retry=self._count_route_fault,
+            )
+        except Exception:  # noqa: BLE001 - exhausted route retries
+            self._counts["route_faults"] += 1
+            _metrics.count("fleet.route_exhausted")
+            return None
+        order = sorted(
+            (rid for rid in self._replicas
+             if self._routable(rid, exclude)),
+            key=lambda rid: _rendezvous_score(off0, rid),
+            reverse=True,
+        )
+        for rid in order:
+            if self._replicas[rid].breaker.allow(now):
+                return rid
+        return None
+
+    def _count_route_fault(self, _attempt, _exc, _delay):
+        self._counts["route_faults"] += 1
+        _metrics.count("fleet.route_faults")
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, config, priority=0, deadline_s=None):
+        """Admit one request into the fleet; returns a `FleetRequest`.
+
+        Brownout rung 1 and all-replicas-shed both complete the handle
+        immediately with ``status == "shed"`` and an actionable
+        ``retry_after_s`` — the fleet door never blocks a client."""
+        now = self._clock()
+        freq = FleetRequest(
+            config, priority=priority, deadline_s=deadline_s,
+            clock=self._clock,
+        )
+        self._counts["requests"] += 1
+        _metrics.count("fleet.requests")
+        if (
+            self._brownout_level >= 1
+            and priority < self.brownout_min_priority
+        ):
+            self._counts["brownout_sheds"] += 1
+            self._counts["shed"] += 1
+            _metrics.count("fleet.brownout_sheds")
+            _trace.instant("fleet.brownout_shed", cat="fleet",
+                           request_id=freq.req_id)
+            freq._complete(
+                RequestResult(
+                    STATUS_SHED, shed_reason="brownout",
+                    retry_after_s=self._brownout_retry_hint(),
+                ),
+                now,
+            )
+            return freq
+        entry = _Entry(freq)
+        self._route_and_send(entry, now)
+        if not freq.done:
+            with self._lock:
+                self._pending[freq.req_id] = entry
+        return freq
+
+    def _route_and_send(self, entry, now):
+        """Offer one request to replicas in routing order until one
+        admits it. Exhaustion sheds a fresh submission (backpressure at
+        the fleet door) but only DEFERS an already-admitted request —
+        failover never drops admitted work."""
+        freq = entry.freq
+        tried = set(entry.shed_rids)
+        while True:
+            rid = self._pick(freq.config.off0, tried, now)
+            if rid is None:
+                break
+            tried.add(rid)
+            deadline_s = (
+                None if freq.deadline_t is None
+                else max(0.0, freq.deadline_t - self._clock())
+            )
+            sub = self._replicas[rid].service.submit(
+                freq.config, priority=freq.priority,
+                deadline_s=deadline_s,
+            )
+            freq.replica_trail.append(rid)
+            res = sub.result
+            if res is not None and res.status == STATUS_SHED:
+                entry.shed_rids.add(rid)
+                if res.retry_after_s is not None:
+                    entry.shed_hints.append(res.retry_after_s)
+                continue
+            if res is not None and not res.ok:
+                # expired at a replica door: terminal, surface it
+                self._finish(entry, res, rid, False, now)
+                return
+            entry.subs.append((rid, sub, False))
+            entry.admitted = True
+            entry.shed_rids.clear()
+            return
+        hint = min(entry.shed_hints) if entry.shed_hints else 0.05
+        if not entry.admitted:
+            self._counts["shed"] += 1
+            _metrics.count("fleet.shed")
+            freq._complete(
+                RequestResult(
+                    STATUS_SHED, shed_reason="fleet",
+                    retry_after_s=hint,
+                ),
+                now,
+            )
+            return
+        # admitted work: defer with the PR-4 jittered backoff ladder
+        delay = max(
+            hint,
+            backoff_delay(
+                entry.reroutes, base_s=self.failover_backoff_s,
+                max_s=self.failover_backoff_max_s,
+            ),
+        )
+        entry.reroutes += 1
+        self._counts["reroutes"] += 1
+        _metrics.count("fleet.reroutes")
+        entry.not_before = now + delay
+        entry.shed_rids.clear()
+
+    # -- supervision ---------------------------------------------------------
+
+    def tick(self, now=None):
+        """One supervision pass: grade health (failing over revoked
+        replicas), settle completed sends, re-route abandoned ones,
+        hedge laggards, update the brownout ladder. The supervisor
+        thread calls this every ``supervise_interval_s``; tests call it
+        directly with an explicit ``now``."""
+        now = self._clock() if now is None else now
+        for rid, _frm, to in self.monitor.check(now):
+            if to == REVOKED:
+                self._on_revoked(rid, now)
+        with self._lock:
+            entries = list(self._pending.values())
+        for entry in entries:
+            self._scan_entry(entry, now)
+        self._update_brownout(now)
+
+    def _on_revoked(self, rid, now):
+        """A replica's lease was revoked: trip its breaker and strand
+        its queue (the ledger scan re-routes every abandoned request)."""
+        replica = self._replicas[rid]
+        replica.breaker.trip(now, reason="health lease revoked")
+        stranded = replica.service.queue.drain()
+        _metrics.count("fleet.revocations")
+        _trace.instant("fleet.replica_revoked", cat="fleet",
+                       replica=rid, stranded=len(stranded))
+        _degrade.record(
+            "fleet", "replica_revoked",
+            f"replica {rid}: lease revoked, {len(stranded)} queued "
+            f"request(s) stranded for failover",
+        )
+        log.warning(
+            "replica %d revoked; failing over %d stranded request(s)",
+            rid, len(stranded),
+        )
+
+    def _scan_entry(self, entry, now):
+        freq = entry.freq
+        if freq.done:
+            with self._lock:
+                self._pending.pop(freq.req_id, None)
+            return
+        if freq.deadline_t is not None and now > freq.deadline_t:
+            self._finish(
+                entry, RequestResult(STATUS_EXPIRED, error="deadline"),
+                None, False, now,
+            )
+            return
+        still = []
+        needs_reroute = False
+        for rid, sub, is_hedge in entry.subs:
+            res = sub.result
+            if res is not None:
+                if res.ok:
+                    self._finish(entry, res, rid, is_hedge, now)
+                    return
+                if res.status == STATUS_SHED:
+                    entry.shed_rids.add(rid)
+                    if res.retry_after_s is not None:
+                        entry.shed_hints.append(res.retry_after_s)
+                    needs_reroute = True
+                    continue
+                # expired / quarantined: terminal, surface truthfully
+                self._finish(entry, res, rid, is_hedge, now)
+                return
+            replica = self._replicas[rid]
+            if replica.dead or replica.lease.revoked:
+                # in-flight on a dead replica: abandoned — failover
+                self._counts["failovers"] += 1
+                _metrics.count("fleet.failover")
+                replica.breaker.record_failure(
+                    now, reason="request abandoned by dead replica"
+                )
+                _trace.instant("fleet.failover", cat="fleet",
+                               request_id=freq.req_id, replica=rid)
+                needs_reroute = True
+                continue
+            still.append((rid, sub, is_hedge))
+        entry.subs = still
+        if not still:
+            if needs_reroute or now >= entry.not_before:
+                if now >= entry.not_before:
+                    self._route_and_send(entry, now)
+            return
+        self._maybe_hedge(entry, now)
+
+    def _finish(self, entry, result, rid, is_hedge, now):
+        won = entry.freq._complete(result, now)
+        with self._lock:
+            self._pending.pop(entry.freq.req_id, None)
+        if not won:
+            return
+        status = result.status
+        if status == STATUS_OK:
+            self._counts["served"] += 1
+            _metrics.count("fleet.served")
+            if rid is not None:
+                self._replicas[rid].breaker.record_success(now)
+            if is_hedge:
+                self._counts["hedge_wins"] += 1
+                _metrics.count("fleet.hedge_wins")
+            self._observe_latency(result.latency_s)
+        elif status == STATUS_SHED:
+            self._counts["shed"] += 1
+            _metrics.count("fleet.shed")
+        elif status == STATUS_EXPIRED:
+            self._counts["expired"] += 1
+            _metrics.count("fleet.expired")
+        else:
+            self._counts["quarantined"] += 1
+            _metrics.count("fleet.quarantined")
+
+    # -- hedging -------------------------------------------------------------
+
+    def _observe_latency(self, latency_s):
+        if len(self._lat) < _LAT_RING:
+            self._lat.append(latency_s)
+        else:
+            self._lat[self._lat_i] = latency_s
+            self._lat_i = (self._lat_i + 1) % _LAT_RING
+        self._p99_dirty += 1
+
+    def _rolling_p99(self):
+        if self._p99_dirty >= 32 or (self._p99_cache == 0.0 and self._lat):
+            lat = sorted(self._lat)
+            self._p99_cache = lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+            self._p99_dirty = 0
+        return self._p99_cache
+
+    def _hedge_budget(self):
+        if self.hedge_budget_s is not None:
+            return self.hedge_budget_s
+        if len(self._lat) < 32:
+            # too few samples for a trustworthy p99: a cold estimate
+            # under-prices the budget and hedges the whole backlog
+            return float("inf")
+        return max(self.hedge_min_s,
+                   self.hedge_factor * self._rolling_p99())
+
+    def _maybe_hedge(self, entry, now):
+        budget = self._hedge_budget()
+        if budget <= 0 or entry.hedged or len(entry.subs) != 1:
+            return
+        if now - entry.freq.submit_t <= budget:
+            return
+        rid0 = entry.subs[0][0]
+        rid = self._pick(entry.freq.config.off0, {rid0}, now)
+        if rid is None:
+            return
+        deadline_s = (
+            None if entry.freq.deadline_t is None
+            else max(0.0, entry.freq.deadline_t - self._clock())
+        )
+        sub = self._replicas[rid].service.submit(
+            entry.freq.config, priority=entry.freq.priority,
+            deadline_s=deadline_s,
+        )
+        entry.freq.replica_trail.append(rid)
+        entry.hedged = True
+        if sub.result is not None and sub.result.status == STATUS_SHED:
+            return  # the hedge was shed; the primary still stands
+        entry.subs.append((rid, sub, True))
+        self._counts["hedges"] += 1
+        _metrics.count("fleet.hedges")
+        _trace.instant("fleet.hedge", cat="fleet",
+                       request_id=entry.freq.req_id, replica=rid)
+
+    # -- brownout ------------------------------------------------------------
+
+    def queue_share(self, window=256):
+        """Recent fleet-wide queue-wait share of request latency (the
+        PR-5 journey decomposition aggregated over replicas) — the
+        brownout trigger signal."""
+        total_q = total = 0.0
+        for replica in self._replicas.values():
+            q, t = replica.service.recent_journey_totals(window)
+            total_q += q
+            total += t
+        return (total_q / total) if total else 0.0
+
+    def queued_depth(self):
+        return sum(
+            len(r.service.queue) for r in self._replicas.values()
+        )
+
+    def _brownout_retry_hint(self):
+        hints = [
+            r.service.queue.retry_after_hint()
+            for r in self._replicas.values()
+        ]
+        return min(hints) if hints else 0.05
+
+    def _set_brownout(self, level, now, share):
+        prev = self._brownout_level
+        if level == prev:
+            return
+        self._brownout_level = level
+        self._brownout_since = now
+        if len(self._brownout_events) < 256:
+            self._brownout_events.append(
+                {"t": round(now, 6), "from": prev, "to": level,
+                 "queue_share": round(share, 4)}
+            )
+        action = f"brownout_level_{level}"
+        _metrics.count(f"fleet.{action}")
+        _degrade.record(
+            "fleet", action,
+            f"queue share {share:.3f} vs threshold "
+            f"{self.brownout_share:.3f}",
+        )
+        if level >= 2 and prev < 2:
+            # rung 2: per-request dispatch — coalesced batches stop
+            # head-of-line-blocking the high-priority traffic that
+            # survived rung 1's shed
+            for rid, replica in self._replicas.items():
+                self._saved_max_batch[rid] = (
+                    replica.service.scheduler.max_batch
+                )
+                replica.service.scheduler.max_batch = 1
+        elif level < 2 and prev >= 2:
+            for rid, saved in self._saved_max_batch.items():
+                self._replicas[rid].service.scheduler.max_batch = saved
+            self._saved_max_batch.clear()
+
+    def _update_brownout(self, now):
+        share = self.queue_share()
+        depth = self.queued_depth()
+        overloaded = (
+            share > self.brownout_share
+            and depth >= self.brownout_min_depth
+        )
+        level = self._brownout_level
+        if overloaded:
+            if level == 0:
+                self._set_brownout(1, now, share)
+            elif (
+                level == 1
+                and now - self._brownout_since > self.brownout_escalate_s
+            ):
+                self._set_brownout(2, now, share)
+        elif level and (
+            share < 0.8 * self.brownout_share
+            or depth < max(1, self.brownout_min_depth // 2)
+        ):
+            # hysteresis: step DOWN one rung at a time
+            self._set_brownout(level - 1, now, share)
+
+    @property
+    def brownout_level(self):
+        return self._brownout_level
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Start every replica pump plus the supervisor thread."""
+        for replica in self._replicas.values():
+            replica.start()
+        self._sup_stop = False
+        trace_ctx = _trace.current()
+        self._sup_thread = threading.Thread(
+            target=self._sup_run, args=(trace_ctx,),
+            name="fleet-supervisor", daemon=True,
+        )
+        self._sup_thread.start()
+        return self
+
+    def _sup_run(self, trace_ctx=0):
+        _trace.adopt(trace_ctx)
+        while not self._sup_stop:
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - supervisor must survive
+                _metrics.count("fleet.supervisor_errors")
+                log.exception("fleet supervisor tick failed")
+            time.sleep(self.supervise_interval_s)
+
+    def drain(self, timeout=None):
+        """Block until no fleet request is pending (the supervisor —
+        thread or caller-driven ticks — completes them); returns True
+        when drained, False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return True
+            if self._sup_thread is None:
+                self.tick()
+            if deadline is not None and time.monotonic() > deadline:
+                with self._lock:
+                    return not self._pending
+            time.sleep(0.002)
+
+    def kill_replica(self, rid):
+        """Drill hook: simulated chip death for one replica."""
+        self._replicas[rid].kill()
+
+    def restore_replica(self, rid):
+        """Bring a dead replica back: fresh pump thread over its warm
+        service state, lease revived. Its breaker is deliberately NOT
+        reset — half-open probe traffic is what re-earns trust."""
+        replica = self._replicas[rid]
+        replica.restore()
+        self.monitor.revive(rid)
+        self._counts["restores"] += 1
+        _metrics.count("fleet.restores")
+        _trace.instant("fleet.replica_restored", cat="fleet",
+                       replica=rid)
+        return replica
+
+    def stop(self, timeout=10.0):
+        """Stop the supervisor and every replica pump (drain first if
+        in-flight work matters)."""
+        self._sup_stop = True
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout)
+            self._sup_thread = None
+        for replica in self._replicas.values():
+            replica.stop(timeout)
+
+    # -- export --------------------------------------------------------------
+
+    def stats(self, wall_s=None):
+        """JSON-ready fleet block (the ``bench.py --fleet`` artifact):
+        counters, rolling latency quantiles, per-replica serving stats
+        (+ QPS when ``wall_s`` is given), breaker/health transition
+        trails, and the brownout ledger."""
+        lat = sorted(self._lat)
+
+        def q(p):
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        per_replica = []
+        for rid, replica in sorted(self._replicas.items()):
+            s = replica.service.stats()
+            row = {
+                "id": rid,
+                "dead": replica.dead,
+                "alive": replica.alive(),
+                "lease_state": replica.lease.state(),
+                "breaker_state": replica.breaker.state,
+                "served": s["n_served"],
+                "requests": s["n_requests"],
+                "shed": s["n_shed"],
+                "p99_ms": s["p99_ms"],
+            }
+            if wall_s:
+                row["qps"] = round(s["n_served"] / wall_s, 2)
+            per_replica.append(row)
+        with self._lock:
+            pending = len(self._pending)
+        return {
+            "n_replicas": len(self._replicas),
+            **{k: v for k, v in self._counts.items()},
+            "pending": pending,
+            "p50_ms": round(q(0.50) * 1e3, 3),
+            "p99_ms": round(q(0.99) * 1e3, 3),
+            "queue_share": round(self.queue_share(), 4),
+            "brownout": {
+                "level": self._brownout_level,
+                "sheds": self._counts["brownout_sheds"],
+                "events": list(self._brownout_events),
+            },
+            "breakers": {
+                str(rid): r.breaker.stats()
+                for rid, r in sorted(self._replicas.items())
+            },
+            "health": self.monitor.stats(),
+            "per_replica": per_replica,
+        }

@@ -6,8 +6,10 @@ overload grows until the host (and the projected device working set)
 is exhausted, and every queued request's latency grows with it.
 `AdmissionQueue` therefore *sheds at the door* — a request is either
 admitted (and will be scheduled) or rejected immediately with a
-``shed`` result the client can retry against another replica — on two
-budgets:
+``shed`` result the client can retry against another replica (the
+result's ``retry_after_s`` hint, priced from the queue's observed
+drain rate by `retry_after_hint`, tells it *when*; the fleet router in
+`serve.fleet` acts on it) — on two budgets:
 
 * **depth** — at most ``max_depth`` requests pending (the classic
   bounded-queue latency cap: past it, added queue depth only adds
@@ -69,6 +71,10 @@ class RequestResult:
     :param batch_size: number of requests the serving dispatch carried
     :param coalesced: True when the request shared its column program
         with at least one other request
+    :param retry_after_s: structured backpressure hint on ``shed``
+        results — seconds after which a retry (against this or another
+        replica) is likely to be admitted, priced from the queue's
+        observed drain rate (`AdmissionQueue.retry_after_hint`)
 
     ``journey`` (set by the service on served requests) decomposes
     ``latency_s`` into contiguous segments
@@ -81,11 +87,12 @@ class RequestResult:
     __slots__ = (
         "status", "data", "error", "latency_s", "path", "batch_size",
         "coalesced", "retries", "shed_reason", "journey",
+        "retry_after_s",
     )
 
     def __init__(self, status, data=None, error=None, latency_s=0.0,
                  path=None, batch_size=0, coalesced=False, retries=0,
-                 shed_reason=None, journey=None):
+                 shed_reason=None, journey=None, retry_after_s=None):
         self.status = status
         self.data = data
         self.error = error
@@ -96,6 +103,7 @@ class RequestResult:
         self.retries = retries
         self.shed_reason = shed_reason
         self.journey = journey
+        self.retry_after_s = retry_after_s
 
     @property
     def ok(self):
@@ -205,6 +213,11 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self._cols = {}  # off0 -> [SubgridRequest, ...] in arrival order
         self._depth = 0
+        # observed drain rate (requests/s leaving via take, EWMA over
+        # inter-take gaps) — prices the retry_after_s shed hint
+        self._drain_rate = 0.0
+        self._last_take_t = None
+        self._taken_total = 0
 
     def __len__(self):
         with self._lock:
@@ -289,6 +302,14 @@ class AdmissionQueue:
             self._depth -= len(taken)
             for r in taken:
                 r.take_t = now
+            if self._last_take_t is not None and now > self._last_take_t:
+                inst = len(taken) / (now - self._last_take_t)
+                self._drain_rate = (
+                    inst if self._drain_rate == 0.0
+                    else 0.8 * self._drain_rate + 0.2 * inst
+                )
+            self._last_take_t = now
+            self._taken_total += len(taken)
             _metrics.gauge("serve.queue_depth", self._depth)
             return taken
 
@@ -310,6 +331,20 @@ class AdmissionQueue:
             if expired:
                 _metrics.gauge("serve.queue_depth", self._depth)
             return expired
+
+    def retry_after_hint(self, now=None):
+        """Seconds after which a shed client's retry is likely to be
+        admitted: the current backlog priced at the observed drain rate
+        (clamped to [0.01, 5.0]; 0.05 before any drain has been
+        observed). The structured half of the shed contract — the
+        docstring's "retry against another replica" made actionable
+        for a router instead of a blind client backoff guess."""
+        with self._lock:
+            depth = self._depth
+            rate = self._drain_rate
+        if rate <= 0.0:
+            return 0.05
+        return min(5.0, max(0.01, (depth + 1) / rate))
 
     def drain(self):
         """Remove and return everything pending (service shutdown)."""

@@ -58,6 +58,7 @@ from ..obs import trace as _trace
 from ..resilience import degrade as _degrade
 from ..resilience.faults import fault_point as _fault_point
 from ..resilience.retry import backoff_delay as _backoff_delay
+from ..resilience.retry import is_oom as _is_oom
 from .queue import (
     STATUS_EXPIRED,
     STATUS_OK,
@@ -104,11 +105,6 @@ def _quantile(sorted_samples, q):
         return 0.0
     i = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
     return sorted_samples[i]
-
-
-def _is_oom(exc) -> bool:
-    text = f"{type(exc).__name__}: {exc}"
-    return "RESOURCE_EXHAUSTED" in text or "out of memory" in text.lower()
 
 
 class SubgridService:
@@ -218,7 +214,10 @@ class SubgridService:
             _trace.instant("serve.shed", cat="serve",
                            request_id=req.req_id, reason=reason)
             req._complete(
-                RequestResult(STATUS_SHED, shed_reason=reason)
+                RequestResult(
+                    STATUS_SHED, shed_reason=reason,
+                    retry_after_s=self.queue.retry_after_hint(),
+                )
             )
             return req
         with self._cond:
@@ -578,6 +577,19 @@ class SubgridService:
                 if served else 1.0
             )
         return out
+
+    def recent_journey_totals(self, window=256):
+        """``(queue_s_total, total_s)`` over the most recent served
+        journeys — the fleet brownout signal (`serve.fleet` divides the
+        aggregates across replicas: a queue share near 1 means requests
+        spend their life waiting, not computing)."""
+        js = list(self._journeys)[-window:]
+        if not js:
+            return 0.0, 0.0
+        total = sum(
+            j["queue_s"] + j["compute_s"] + j["transfer_s"] for j in js
+        )
+        return sum(j["queue_s"] for j in js), total
 
     def _journey_stats(self):
         """The request-journey decomposition block: per-segment p50/p99

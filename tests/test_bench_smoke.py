@@ -216,6 +216,105 @@ def test_bench_serve_smoke_leg(tmp_path):
     ) == record["n_served"]
 
 
+def test_bench_fleet_smoke_leg(tmp_path):
+    """The `bench.py --fleet --smoke` leg: 3 SubgridService replicas
+    behind the rendezvous column router with health leases + circuit
+    breakers, one replica killed mid-zipf-workload and restored, run
+    exactly as the driver would (fresh subprocess, CPU) — zero lost
+    requests, results bit-identical to per-request compute, the
+    victim's breaker cycling open → half-open → closed, p99 recovering
+    to <= 1.5x the pre-kill window, route faults survived, and the
+    brownout ladder (shed-with-hint, per-request dispatch, recovery)
+    all validated via `obs.validate_fleet_artifact`."""
+    out = tmp_path / "BENCH_fleet.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_FLEET_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--fleet", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["fleet_smoke"] == "ok", summary
+    assert summary["problems"] == []
+
+    # re-validate the artifact out-of-process (the drill's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_fleet_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_fleet_artifact(record) == []
+    fl = record["fleet"]
+    assert fl["zero_lost"] is True
+    assert record["bit_identical"]["mismatches"] == 0
+    assert record["bit_identical"]["checked"] == record["n_served"]
+    assert fl["replica_deaths"] == 1 and fl["restores"] == 1
+    assert fl["failovers"] >= 1
+    # the victim's breaker cycled, in order
+    cyc = fl["breaker_cycle"]
+    i_open = cyc.index("open")
+    i_half = cyc.index("half_open", i_open)
+    assert "closed" in cyc[i_half:]
+    # p99 recovered within the drill window
+    assert fl["p99_after_ms"] <= 1.5 * fl["p99_before_ms"]
+    # the victim's lease was revoked and revived
+    victim = fl["victim"]
+    trans = fl["health_transitions"]
+    assert any(
+        h["owner"] == victim and h["to"] == "revoked" for h in trans
+    )
+    assert any(
+        h["owner"] == victim and h["to"] == "live" for h in trans
+    )
+    # overload drill: injected route faults survived; brownout walked
+    # the full ladder and recovered
+    assert fl["route_faults"] >= 1
+    bo = fl["brownout"]
+    assert bo["sheds"] >= 1 and bo["retry_after_hints"]
+    assert bo["level_max"] == 2 and bo["per_request_dispatch"]
+    assert bo["batch_restored"] and bo["level"] == 0
+    # per-replica QPS table covers the fleet
+    assert len(fl["per_replica"]) == 3
+    assert all("qps" in row for row in fl["per_replica"])
+    assert sum(row["served"] for row in fl["per_replica"]) >= record[
+        "n_served"
+    ]
+    # telemetry carries the fleet/health/breaker vocabulary
+    counters = record["telemetry"]["counters"]
+    assert counters["fleet.requests"] == record["n_requests"]
+    assert counters["fleet.replica_deaths"] == 1
+    assert counters["fleet.restores"] == 1
+    assert counters["breaker.to_open"] >= 1
+    assert counters["breaker.to_closed"] >= 1
+    assert counters["health.revoked"] >= 1
+    assert record["manifest"]["device"]["platform"] == "cpu"
+
+    # --- the serving sentinel (in-process: no extra spawn) ------------
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_fleet_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 0
+    # doctored 2x-better reference (half the p99, double the QPS) ->
+    # the p99/QPS sentinel must trip non-zero
+    doctored = dict(record)
+    doctored["p99_ms"] = record["p99_ms"] / 2.0
+    doctored["throughput_rps"] = record["throughput_rps"] * 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 1
+
+
 def _run_chaos(tmp_path, extra_args=(), config=None, timeout=540):
     out = tmp_path / "BENCH_chaos.json"
     env = dict(os.environ)

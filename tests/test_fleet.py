@@ -1,0 +1,630 @@
+"""Self-healing serve fleet tests.
+
+The fleet contract, pinned:
+
+* BREAKER — closed opens after consecutive failures (successes reset
+  the count), open admits nothing until the jittered reopen deadline,
+  half-open admits a bounded probe budget whose successes close it and
+  whose ANY failure re-opens with an escalated deadline; `trip` forces
+  open on external evidence; every transition is recorded;
+* LEASES — missed beats grade live → suspect → revoked; a beat during
+  suspicion revives without failover (the revival race is a non-event);
+  revocation latches (zombie beats ignored) until an explicit revive;
+  a probe failure revokes a suspect immediately;
+* ROUTING — rendezvous hashing is column-stable and spreads columns
+  over replicas; shed replicas are skipped; exhaustion returns a
+  structured fleet-level shed with a ``retry_after_s`` hint;
+* FAILOVER — a dead replica's queued + in-flight admitted requests
+  re-route to survivors (zero loss); an already-completed request is
+  NEVER re-issued; the victim's breaker opens;
+* HEDGING — a request pending past the hedge budget is duplicated
+  once; the first completion wins;
+* BROWNOUT — a high queue-wait share sheds low-priority submissions
+  with ``retry_after_s`` (rung 1) then degrades to per-request
+  dispatch (rung 2), and hysteresis restores both;
+* the full kill/restore drill at real-engine scale stays bit-identical
+  (`-m slow` gates the big multi-replica bench drill).
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from swiftly_tpu.resilience.retry import is_oom
+from swiftly_tpu.serve import service as serve_service
+from swiftly_tpu.serve.fleet import ServeFleet
+from swiftly_tpu.serve.health import (
+    LIVE,
+    REVOKED,
+    SUSPECT,
+    HealthLease,
+    HealthMonitor,
+)
+from swiftly_tpu.serve.queue import (
+    STATUS_OK,
+    STATUS_SHED,
+    AdmissionQueue,
+    RequestResult,
+    SubgridRequest,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("reopen_s", 0.5)
+    kw.setdefault("half_open_probes", 2)
+    kw.setdefault("rng", random.Random(0))
+    return CircuitBreaker("b", clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_opens_after_consecutive_failures():
+    clk = _Clock()
+    b = _breaker(clk)
+    assert b.allow() and b.state == CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == OPEN and not b.allow()
+
+
+def test_breaker_success_resets_failure_count():
+    clk = _Clock()
+    b = _breaker(clk)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # streak broken
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CLOSED
+
+
+def test_breaker_half_open_probe_budget_and_close():
+    clk = _Clock()
+    b = _breaker(clk)
+    for _ in range(3):
+        b.record_failure()
+    assert not b.allow()
+    clk.t += 1.0  # past the (jittered, <= reopen_s) deadline
+    assert b.allow()            # probe 1 transitions to half-open
+    assert b.state == HALF_OPEN
+    assert b.allow()            # probe 2
+    assert not b.allow()        # probe budget exhausted
+    b.record_success()
+    assert b.state == HALF_OPEN  # one success is not enough
+    b.record_success()
+    assert b.state == CLOSED
+    assert [t["to"] for t in b.transitions] == [
+        "open", "half_open", "closed"
+    ]
+
+
+def test_breaker_half_open_probe_failure_reopens_escalated():
+    """The half-open edge case: a failed probe re-opens, and the
+    reopen deadline escalates with each consecutive open."""
+    clk = _Clock()
+    b = _breaker(clk, reopen_s=0.5, max_reopen_s=64.0)
+    for _ in range(3):
+        b.record_failure()
+    clk.t += 1.0
+    assert b.allow() and b.state == HALF_OPEN
+    b.record_failure()          # probe fails
+    assert b.state == OPEN
+    # escalation: the 2nd open's delay draws from base*2 (jitter in
+    # [0.5, 1.0)), i.e. at least 0.5s — a bare base-delay wait may not
+    # reopen yet; 2*base always does
+    clk.t += 1.0
+    assert b.allow() and b.state == HALF_OPEN
+    b.record_failure()
+    assert b.state == OPEN
+    opens = [t for t in b.transitions if t["to"] == "open"]
+    assert len(opens) == 3
+
+
+def test_breaker_trip_forces_open_and_probes_reclose():
+    clk = _Clock()
+    b = _breaker(clk)
+    b.trip(reason="lease revoked")
+    assert b.state == OPEN
+    b.trip(reason="again")  # no-op when already open
+    assert sum(1 for t in b.transitions if t["to"] == "open") == 1
+    clk.t += 1.0
+    assert b.allow()
+    b.record_success()
+    assert b.allow()
+    b.record_success()
+    assert b.state == CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Health leases + monitor
+# ---------------------------------------------------------------------------
+
+
+def test_lease_grades_by_missed_beats():
+    clk = _Clock()
+    lease = HealthLease("r", interval_s=0.1, miss_suspect=2,
+                        miss_revoke=5, clock=clk)
+    lease.beat(100.0)
+    assert lease.state(100.15) == LIVE
+    assert lease.state(100.25) == SUSPECT
+    assert lease.state(100.45) == SUSPECT
+    assert lease.state(100.55) == REVOKED
+
+
+def test_lease_revival_race_is_a_non_event():
+    """A suspect replica that beats again goes back to live — no
+    failover; but once REVOKED latches, late (zombie) beats are
+    counted and ignored until an explicit revive."""
+    clk = _Clock()
+    lease = HealthLease("r", interval_s=0.1, miss_suspect=2,
+                        miss_revoke=5, clock=clk)
+    lease.beat(100.0)
+    assert lease.state(100.3) == SUSPECT
+    assert lease.beat(100.3) is True      # the race: beat wins
+    assert lease.state(100.35) == LIVE
+    lease.revoke()
+    assert lease.state(100.35) == REVOKED
+    assert lease.beat(100.36) is False    # zombie beat ignored
+    assert lease.zombie_beats == 1
+    assert lease.state(100.4) == REVOKED  # still revoked
+    lease.revive(100.5)
+    assert lease.state(100.5) == LIVE
+    assert lease.beat(100.55) is True
+
+
+def test_monitor_probe_revives_slow_but_alive_replica():
+    clk = _Clock()
+    lease = HealthLease("r", interval_s=0.1, miss_suspect=2,
+                        miss_revoke=50, clock=clk)
+    mon = HealthMonitor(probe=lambda key: True, clock=clk)
+    mon.register("r", lease)
+    lease.beat(100.0)
+    clk.t = 100.3  # suspect; probe says alive -> lease renewed
+    assert mon.check() == []
+    assert lease.state(100.35) == LIVE
+
+
+def test_monitor_probe_failure_revokes_suspect_immediately():
+    clk = _Clock()
+    lease = HealthLease("r", interval_s=0.1, miss_suspect=2,
+                        miss_revoke=50, clock=clk)
+    mon = HealthMonitor(probe=lambda key: False, clock=clk)
+    mon.register("r", lease)
+    lease.beat(100.0)
+    clk.t = 100.3  # suspect (far from miss_revoke); probe fails
+    assert mon.check() == [("r", LIVE, REVOKED)]
+    assert lease.revoked
+    assert mon.stats()["transitions"][0]["to"] == REVOKED
+
+
+# ---------------------------------------------------------------------------
+# Shed hints + the shared OOM classifier (satellites)
+# ---------------------------------------------------------------------------
+
+
+class _Cfg:
+    def __init__(self, off0, off1=0, size=16):
+        self.off0 = off0
+        self.off1 = off1
+        self.size = size
+
+
+def test_retry_after_hint_prices_backlog_at_drain_rate():
+    q = AdmissionQueue(max_depth=100)
+    assert q.retry_after_hint() == 0.05  # no drain observed yet
+    for i in range(20):
+        q.offer(SubgridRequest(_Cfg(0, i)), now=100.0)
+    q.take(0, limit=10, now=100.0)
+    q.take(0, limit=10, now=101.0)  # 10 requests/s observed
+    for i in range(10):
+        q.offer(SubgridRequest(_Cfg(0, i)), now=101.0)
+    # depth 10 at ~10 rps -> ~1.1s hint
+    assert 0.5 <= q.retry_after_hint() <= 2.0
+    # clamped at the top for a huge backlog over a trickle rate
+    q2 = AdmissionQueue(max_depth=10000)
+    for i in range(2000):
+        q2.offer(SubgridRequest(_Cfg(0, i)), now=100.0)
+    q2.take(0, limit=1, now=100.0)
+    q2.take(0, limit=1, now=110.0)  # 0.1 rps
+    assert q2.retry_after_hint() == 5.0
+
+
+def test_is_oom_is_the_one_shared_classifier():
+    assert is_oom(MemoryError())
+    assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: out of HBM"))
+    assert is_oom(RuntimeError("backend ran Out Of Memory here"))
+    assert not is_oom(ValueError("shape mismatch"))
+    assert not is_oom(IOError("disk gone"))
+    # serve and bench both delegate to it, not to private forks
+    assert serve_service._is_oom is is_oom
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+
+        assert bench._is_oom(RuntimeError("RESOURCE_EXHAUSTED: x"))
+        assert not bench._is_oom(ValueError("nope"))
+    finally:
+        sys.path.remove(str(REPO))
+
+
+# ---------------------------------------------------------------------------
+# Fleet logic (stub services — routing, failover, hedge, brownout)
+# ---------------------------------------------------------------------------
+
+
+class _StubSched:
+    def __init__(self):
+        self.max_batch = 8
+
+
+class _StubService:
+    """The SubgridService surface the fleet touches, minus the engine:
+    submissions queue, `pump()` serves everything with a payload that
+    names the serving replica."""
+
+    def __init__(self, rid, max_depth=64):
+        self.rid = rid
+        self.queue = AdmissionQueue(max_depth=max_depth)
+        self.scheduler = _StubSched()
+        self.served = 0
+        self.journeys = (0.0, 0.0)
+
+    def submit(self, config, priority=0, deadline_s=None):
+        req = SubgridRequest(config, priority=priority,
+                             deadline_s=deadline_s)
+        ok, reason = self.queue.offer(req)
+        if not ok:
+            req._complete(
+                RequestResult(
+                    STATUS_SHED, shed_reason=reason,
+                    retry_after_s=self.queue.retry_after_hint(),
+                )
+            )
+        return req
+
+    def pump(self):
+        for col in list(self.queue.columns()):
+            for r in self.queue.take(col.off0):
+                self.served += 1
+                r._complete(
+                    RequestResult(STATUS_OK,
+                                  data=(self.rid, r.config.off0))
+                )
+
+    def recent_journey_totals(self, window=256):
+        return self.journeys
+
+    def stats(self):
+        return {"n_served": self.served, "n_requests": self.served,
+                "n_shed": 0, "p99_ms": 1.0}
+
+
+def _stub_fleet(clk, n=3, **kw):
+    kw.setdefault("lease_interval_s", 0.1)
+    kw.setdefault("miss_suspect", 2)
+    kw.setdefault("miss_revoke", 4)
+    kw.setdefault("seed", 7)
+    fleet = ServeFleet(
+        lambda rid: _StubService(rid), n, clock=clk, **kw
+    )
+    for r in fleet.replicas.values():
+        r.lease.beat(clk.t)
+    return fleet
+
+
+def _beat(fleet, clk, exclude=()):
+    for rid, r in fleet.replicas.items():
+        if rid not in exclude:
+            r.lease.beat(clk.t)
+
+
+def test_fleet_routing_is_column_stable_and_spread():
+    clk = _Clock()
+    fleet = _stub_fleet(clk)
+    # same column -> same replica, every time
+    for off0 in range(8):
+        rids = {
+            fleet.submit(_Cfg(off0, i), priority=1).replica_trail[-1]
+            for i in range(3)
+        }
+        assert len(rids) == 1
+        assert rids.pop() == fleet.preferred_replica(off0)
+    # many columns spread over more than one replica
+    owners = {fleet.preferred_replica(off0) for off0 in range(32)}
+    assert len(owners) >= 2
+    for r in fleet.replicas.values():
+        r.service.pump()
+    fleet.tick(clk.t)
+    assert fleet.stats()["served"] == 24
+
+
+def test_fleet_failover_reroutes_admitted_work_zero_loss():
+    clk = _Clock()
+    fleet = _stub_fleet(clk)
+    victim = fleet.preferred_replica(5)
+    freq = fleet.submit(_Cfg(5), priority=1)
+    assert freq.replica_trail == [victim]
+    fleet.replica(victim).dead = True
+    clk.t += 0.5
+    _beat(fleet, clk, exclude={victim})
+    fleet.tick(clk.t)   # probe fails -> revoked -> queue stranded
+    clk.t += 0.5
+    _beat(fleet, clk, exclude={victim})
+    fleet.tick(clk.t)   # past the backoff gate: rerouted to a survivor
+    for rid, r in fleet.replicas.items():
+        if rid != victim:
+            r.service.pump()
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.ok
+    assert freq.result.data[0] != victim
+    st = fleet.stats()
+    assert st["failovers"] >= 1 and st["served"] == 1
+    assert fleet.replica(victim).breaker.state == OPEN
+    assert any(
+        h["owner"] == victim and h["to"] == REVOKED
+        for h in st["health"]["transitions"]
+    )
+
+
+def test_fleet_already_completed_request_is_not_failed_over():
+    """The failover edge case: a request whose result landed before
+    the supervisor noticed its replica died must complete from that
+    result — never be re-issued."""
+    clk = _Clock()
+    fleet = _stub_fleet(clk)
+    freq = fleet.submit(_Cfg(1), priority=1)
+    rid = freq.replica_trail[-1]
+    fleet.replica(rid).service.pump()     # served; scan hasn't run yet
+    fleet.replica(rid).dead = True        # ...and now the replica dies
+    clk.t += 0.5
+    _beat(fleet, clk, exclude={rid})
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.ok
+    st = fleet.stats()
+    assert st["failovers"] == 0 and st["reroutes"] == 0
+    assert st["served"] == 1
+    total_submitted = sum(
+        r.service.served + len(r.service.queue)
+        for r in fleet.replicas.values()
+    )
+    assert total_submitted == 1  # no duplicate send ever left the door
+
+
+def test_fleet_hedge_first_completion_wins():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2, hedge_budget_s=0.2,
+                        lease_interval_s=10.0)
+    freq = fleet.submit(_Cfg(3), priority=1)
+    primary = freq.replica_trail[-1]
+    clk.t += 0.5  # pending past the budget
+    fleet.tick(clk.t)
+    st = fleet.stats()
+    assert st["hedges"] == 1
+    other = next(r for r in fleet.replicas if r != primary)
+    fleet.replica(other).service.pump()   # the hedge lands first
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.ok
+    assert freq.result.data[0] == other
+    assert fleet.stats()["hedge_wins"] == 1
+    # the primary's (loser) completion cannot overwrite the winner
+    fleet.replica(primary).service.pump()
+    fleet.tick(clk.t)
+    assert freq.result.data[0] == other
+    assert fleet.stats()["served"] == 1
+
+
+def test_fleet_all_replicas_shed_returns_structured_shed():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2)
+    for rid, r in fleet.replicas.items():
+        r.service.queue = AdmissionQueue(max_depth=1)
+    a = fleet.submit(_Cfg(0), priority=1)
+    b = fleet.submit(_Cfg(0), priority=1)  # preferred replica full
+    assert a.result is None
+    # b overflowed its preferred replica and fell to the other one
+    assert not b.done or b.result.ok
+    c = fleet.submit(_Cfg(0), priority=1)  # both full now
+    assert c.done and c.result.status == STATUS_SHED
+    assert c.result.shed_reason == "fleet"
+    assert c.result.retry_after_s is not None
+
+
+def test_fleet_brownout_ladder_and_recovery():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2, lease_interval_s=10.0,
+                        brownout_share=0.5, brownout_min_depth=1,
+                        brownout_escalate_s=0.1)
+    for r in fleet.replicas.values():
+        r.service.journeys = (9.0, 10.0)  # queue share 0.9
+    held = fleet.submit(_Cfg(2), priority=1)  # creates queued depth
+    fleet.tick(clk.t)
+    assert fleet.brownout_level == 1
+    low = fleet.submit(_Cfg(2), priority=0)
+    assert low.done and low.result.status == STATUS_SHED
+    assert low.result.shed_reason == "brownout"
+    assert low.result.retry_after_s is not None
+    high = fleet.submit(_Cfg(2), priority=1)  # above the floor: admitted
+    assert high.result is None
+    clk.t += 0.2
+    fleet.tick(clk.t)
+    assert fleet.brownout_level == 2  # rung 2: per-request dispatch
+    assert all(
+        r.service.scheduler.max_batch == 1
+        for r in fleet.replicas.values()
+    )
+    # pressure clears -> hysteresis steps down one rung per tick and
+    # restores the coalescing batch size
+    for r in fleet.replicas.values():
+        r.service.journeys = (0.0, 10.0)
+        r.service.pump()
+    fleet.tick(clk.t)
+    fleet.tick(clk.t)
+    assert fleet.brownout_level == 0
+    assert all(
+        r.service.scheduler.max_batch == 8
+        for r in fleet.replicas.values()
+    )
+    assert fleet.stats()["brownout"]["sheds"] == 1
+    for fr in (held, high):
+        fleet.tick(clk.t)
+        assert fr.done and fr.result.ok
+
+
+# ---------------------------------------------------------------------------
+# Real-engine integration: threaded fleet, kill, bit-identity
+# ---------------------------------------------------------------------------
+
+TEST_PARAMS = {
+    "W": 13.5625,
+    "fov": 1.0,
+    "N": 1024,
+    "yB_size": 416,
+    "yN_size": 512,
+    "xA_size": 228,
+    "xM_size": 256,
+}
+
+SOURCES = [(1, 1, 0), (0.5, -30, 40)]
+
+
+@pytest.fixture(scope="module")
+def cover():
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+
+    config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    return config, facet_tasks, subgrid_configs
+
+
+def _real_fleet(cover, n=3, **kw):
+    from swiftly_tpu import SwiftlyForward
+    from swiftly_tpu.serve import CoalescingScheduler, SubgridService
+
+    config, facet_tasks, _sgs = cover
+
+    def factory(rid):
+        fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=50)
+        return SubgridService(
+            fwd, scheduler=CoalescingScheduler(max_batch=8)
+        )
+
+    kw.setdefault("lease_interval_s", 0.05)
+    kw.setdefault("miss_suspect", 2)
+    kw.setdefault("miss_revoke", 5)
+    kw.setdefault("breaker_reopen_s", 0.2)
+    kw.setdefault("seed", 11)
+    return ServeFleet(factory, n, **kw)
+
+
+def test_fleet_kill_failover_stays_bit_identical(cover):
+    """The acceptance pin at test scale: kill the replica owning the
+    densest backlog mid-workload; every request completes on survivors,
+    results bit-identical to per-request compute on a fresh forward."""
+    from swiftly_tpu import SwiftlyForward
+
+    config, facet_tasks, sgs = cover
+    fleet = _real_fleet(cover)
+    try:
+        fleet.start()
+        # aim the whole workload at ONE replica so its death strands a
+        # multi-column backlog (the interesting failover case)
+        victim = fleet.preferred_replica(sgs[0].off0)
+        workload = [
+            sg for sg in sgs
+            if fleet.preferred_replica(sg.off0) == victim
+        ]
+        assert len(workload) >= 3
+        reqs = [fleet.submit(sg, priority=1) for sg in workload]
+        fleet.kill_replica(victim)
+        assert fleet.drain(timeout=180.0)
+        for r in reqs:
+            res = r.wait(timeout=60.0)
+            assert res is not None and res.ok, res
+        st = fleet.stats()
+        assert fleet.replica(victim).dead
+        assert st["failovers"] + st["hedges"] >= 1
+        assert any(
+            h["owner"] == victim and h["to"] == REVOKED
+            for h in st["health"]["transitions"]
+        )
+        assert fleet.replica(victim).breaker.state == OPEN
+    finally:
+        fleet.stop()
+    fwd_ref = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=50)
+    for sg, req in zip(workload, reqs):
+        np.testing.assert_array_equal(
+            np.asarray(req.result.data),
+            np.asarray(fwd_ref.get_subgrid_task(sg)),
+        )
+
+
+@pytest.mark.slow
+def test_fleet_full_drill(tmp_path):
+    """The full multi-replica kill/restore drill through `bench.py
+    --fleet --smoke` at a larger phase size — the slow-gated rehearsal
+    of the acceptance contract (zero loss, bit-identity, breaker
+    cycle, p99 recovery) beyond the tier-1 smoke scale."""
+    out = tmp_path / "BENCH_fleet_full.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_FLEET_OUT=str(out),
+        BENCH_FLEET_REPLICAS="4",
+        BENCH_FLEET_PHASE_REQUESTS="160",
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--fleet", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=1800,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["fleet_smoke"] == "ok", summary
+    record = json.loads(out.read_text())
+    from swiftly_tpu.obs import validate_fleet_artifact
+
+    assert validate_fleet_artifact(record) == []
+    assert record["fleet"]["n_replicas"] == 4
+    assert record["fleet"]["zero_lost"] is True
