@@ -333,65 +333,19 @@ def _plan_backward_passes(
 ):
     """Facet x output-row-slab partition plan for the sampled backward.
 
-    Returns ``(parts, resident_bytes)``: `parts` is the pass list
-    [(i0, i1, r0, r1), ...] — facet subset [i0, i1) x accumulator rows
-    [r0, r1) — and `resident_bytes` the largest pass's accumulator +
-    row-pipeline residency (what the forward's auto-sizers must leave
-    free, `fwd.hbm_headroom`).
-
-    Partition order: facets first (the 64k mechanism — single-facet
-    passes leave the shared subgrid stream the most headroom), then
-    output-row slabs within a facet once even ONE facet's accumulator
-    exceeds the per-pass budget (the 128k mechanism: one 45056^2 facet
-    is 16.2 GiB; the fold's "ri" index restricts trivially, see
-    `StreamedBackward(row_slab=...)`). Every pass consumes the SAME
-    subgrid stream, so with the spill cache the total cost is one
-    forward + len(parts) cache-fed backward passes.
-
-    :param per_facet_acc: one facet's WHOLE [yB, yB] accumulator bytes
-    :param per_facet_rows: one facet's [m, yB] column-rows bytes (the
-        fold pipeline keeps 2*fold_group + 2 of these live per facet)
-    :param budget: per-device HBM bytes (None = unpartitioned, e.g. CPU)
-    :param n_facet_env / n_row_env: operator overrides
-        (BENCH_BWD_FACET_PASSES / BENCH_BWD_ROW_SLABS)
+    Delegates to the unified plan compiler
+    (`swiftly_tpu.plan.compiler.plan_backward_passes`, where the
+    partition heuristic moved verbatim) — this wrapper keeps the
+    historical bench entry point the 128k tests and operator docs name.
+    Returns ``(parts, resident_bytes)`` exactly as before.
     """
-    rows_resident = (2 * fold_group + 2) * per_facet_rows
-    usable = None if budget is None else budget - fwd_min - reserve
-    if n_facet_env:
-        n_parts = max(1, min(int(n_facet_env), F_total))
-    elif usable is None:
-        n_parts = 1
-    elif F_total * (per_facet_acc + rows_resident) <= usable:
-        n_parts = 1
-    else:
-        # once partitioning is forced, single-facet passes win: the
-        # stream feed dominates each pass and its sizing scales with
-        # the headroom the accumulator leaves (measured at 64k)
-        n_parts = F_total
-    F_sub = -(-F_total // n_parts)
-    n_row = 1
-    if n_row_env:
-        n_row = max(1, min(int(n_row_env), yB))
-    elif usable is not None and n_parts > 1:
-        per_pass = F_sub * (per_facet_acc + rows_resident)
-        if per_pass > usable:
-            # slab the accumulator; the column rows stay full-width
-            # (the fold consumes every row whatever slab it outputs)
-            acc_budget = usable - F_sub * rows_resident
-            per_row = max(1.0, F_sub * per_facet_acc / yB)
-            h = int(acc_budget // per_row) if acc_budget > 0 else 0
-            n_row = -(-yB // max(1, h))
-    row_h = -(-yB // n_row)
-    parts = [
-        (i0, min(i0 + F_sub, F_total), r0, min(r0 + row_h, yB))
-        for i0 in range(0, F_total, F_sub)
-        for r0 in range(0, yB, row_h)
-    ]
-    resident = max(
-        (i1 - i0) * (per_facet_acc * (r1 - r0) / yB + rows_resident)
-        for i0, i1, r0, r1 in parts
+    from swiftly_tpu.plan import plan_backward_passes
+
+    return plan_backward_passes(
+        F_total, yB, per_facet_acc, per_facet_rows, fold_group, budget,
+        fwd_min=fwd_min, reserve=reserve,
+        n_facet_env=n_facet_env, n_row_env=n_row_env,
     )
-    return parts, int(resident)
 
 
 def _numpy_baseline_from_parts(params, sources, reps=3):
@@ -709,6 +663,20 @@ def run_one(config_name, mode):
         plan = fwd.last_plan or {}
         extra["facets_real"] = fwd._facets_real
         extra["plan"] = plan
+        # compiled-plan block for the forward leg too: the same model
+        # prices what the executor's sizers chose, so plan coverage is
+        # not limited to the roundtrip legs
+        from swiftly_tpu.plan import PlanInputs, compile_plan
+        from swiftly_tpu.plan import hbm_budget_bytes as _hbm_budget_env
+
+        extra["plan_compiled"] = compile_plan(
+            PlanInputs.from_cover(
+                config, facet_configs, subgrid_configs,
+                hbm_budget=_hbm_budget_env(),
+                real_facets=fwd._facets_real,
+            ),
+            mode="streamed",
+        ).artifact_block()
     elif mode == "roundtrip-streamed":
         import jax.numpy as jnp
 
@@ -741,30 +709,56 @@ def run_one(config_name, mode):
         # forward runs once and passes 2..P are cache-fed — before the
         # cache, each pass replayed the full forward (~8 x 73 s of the
         # 64k round trip's 703 s).
-        from swiftly_tpu.utils.profiling import probe_hbm_bytes
-
-        env_hbm = os.environ.get("SWIFTLY_HBM_BUDGET")
-        budget = (
-            float(env_hbm)
-            if env_hbm
-            else (probe_hbm_bytes() or None)
+        from swiftly_tpu.plan import PlanInputs, compile_plan
+        from swiftly_tpu.plan import hbm_budget_bytes as _hbm_budget_env
+        from swiftly_tpu.plan.model import (
+            DEFAULT_FWD_MIN_BYTES,
+            DEFAULT_RESERVE_BYTES,
         )
-        fwd_min = 3.3e9  # measured: the 32k roundtrip fwd plan (G=3,
-        # slab_depth=2) streams green inside this
-        reserve = 1.2e9  # fold row-blocks + donation-copy slack
+
+        # the one SWIFTLY_HBM_BUDGET parse (plan.hbm_budget_bytes) —
+        # bench used to read the env var next to the streamed
+        # executors' own copy
+        budget = _hbm_budget_env()
+        fwd_min = DEFAULT_FWD_MIN_BYTES  # measured: the 32k roundtrip
+        # fwd plan (G=3, slab_depth=2) streams green inside this
+        reserve = DEFAULT_RESERVE_BYTES  # fold row-blocks +
+        # donation-copy slack
+        plan_inputs = PlanInputs.from_cover(
+            config, facet_configs, subgrid_configs, hbm_budget=budget,
+            real_facets=getattr(fwd, "_facets_real", False),
+        )
+        # measured-feedback autotune: BENCH_PLAN_HISTORY names artifact
+        # globs whose per-stage telemetry refits the model's throughput
+        # coefficients (plan.autotune); unset -> static defaults, and
+        # the compiled plan is provably the old heuristics' plan
+        plan_history = os.environ.get("BENCH_PLAN_HISTORY") or None
+        plan_state = {"plan": None}
 
         def _make_plan():
             # re-planned per run: _oom_soft may have shrunk fold_group
-            return _plan_backward_passes(
-                F_total, yB, per_facet_acc, per_facet_rows,
-                fold_group[0], budget, fwd_min=fwd_min, reserve=reserve,
+            # (after an OOM the shrunk value is binding — history-based
+            # reselection must not grow it back)
+            cplan = compile_plan(
+                plan_inputs.replace(fold_group=fold_group[0]),
+                history=(
+                    plan_history.split(",")
+                    if plan_history and not extra.get("oom_retries")
+                    else None
+                ),
+                fwd_min=fwd_min, reserve=reserve,
                 n_facet_env=int(
                     os.environ.get("BENCH_BWD_FACET_PASSES", "0")
                 ),
                 n_row_env=int(
                     os.environ.get("BENCH_BWD_ROW_SLABS", "0")
                 ),
+                allow_spill=os.environ.get("BENCH_SPILL", "1") != "0",
             )
+            fold_group[0] = cplan.backward.fold_group
+            plan_state["plan"] = cplan
+            extra["plan_compiled"] = cplan.artifact_block()
+            return cplan.backward.parts, cplan.backward.resident_bytes
 
         def _verify_part(facets_dev, i0, i1, r0, r1):
             """Device-side RMS of reproduced facet (row-slab) [i0:i1) x
@@ -845,9 +839,8 @@ def run_one(config_name, mode):
             `fwd.passes`). A stream too large for the cache budget
             falls back to forward replay per pass — exact, just the
             pre-cache cost model."""
-            from swiftly_tpu.utils.spill import SpillCache
-
             parts, resident = _make_plan()
+            cplan = plan_state["plan"]
             fwd.hbm_headroom = int(resident + reserve)
             n_facet_passes = len({(p[0], p[1]) for p in parts})
             n_row_slabs = len({(p[2], p[3]) for p in parts})
@@ -856,11 +849,13 @@ def run_one(config_name, mode):
                 "n_facet_passes": n_facet_passes,
                 "n_row_slabs": n_row_slabs,
             }
-            use_spill = (
-                len(parts) > 1
-                and os.environ.get("BENCH_SPILL", "1") != "0"
+            # the spill policy (cache budget, RAM/disk/replay) is the
+            # compiled plan's third output — SpillCache no longer prices
+            # the stream for itself on this path
+            spill = (
+                cplan.spill.make_cache() if cplan.spill.use_spill
+                else None
             )
-            spill = SpillCache() if use_spill else None
             passes0 = 0
             if metrics.enabled():
                 passes0 = (metrics.export().get("counters") or {}).get(
@@ -1125,6 +1120,15 @@ def run_one(config_name, mode):
 
     leg_span.__exit__(None, None, None)
     leg_wall_s = time.perf_counter() - t_leg0
+    if "plan_compiled" in extra:
+        # close the loop: the stamped plan carries predicted vs MEASURED
+        # wall, which is what bench_compare's mispricing flag and the
+        # autotune history read back
+        pc = extra["plan_compiled"]
+        pc["measured_wall_s"] = round(elapsed, 4)
+        pred = (pc.get("predicted") or {}).get("wall_s") or 0
+        if pred and elapsed:
+            pc["predicted_vs_measured"] = round(pred / elapsed, 3)
     direction = (
         "forward+backward round-trip"
         if mode in ("roundtrip", "roundtrip-streamed")
@@ -1682,6 +1686,19 @@ def fleet_bench(smoke_mode=False):
             max_retries=2,
         )
 
+    # admission costing from the unified plan compiler: the fleet's
+    # per-request / per-column byte model is the compiled plan's serve
+    # block (no cap here — the drill's phases must admit everything;
+    # the pricing lands in the artifact's admission stats)
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+
+    fleet_plan = compile_plan(
+        PlanInputs.from_cover(
+            config, facet_configs, subgrid_configs,
+            max_batch=max_batch,
+        ),
+        mode="streamed",
+    )
     fleet = ServeFleet(
         replica_factory, n_replicas,
         lease_interval_s=0.02, miss_suspect=3, miss_revoke=6,
@@ -1693,6 +1710,8 @@ def fleet_bench(smoke_mode=False):
         brownout_share=2.0, brownout_min_depth=8,
         brownout_escalate_s=0.1,
         failover_backoff_s=0.01, seed=seed,
+        request_bytes=fleet_plan.serve.request_bytes,
+        column_bytes=fleet_plan.serve.column_bytes,
     )
 
     # one shared workload per phase (same seed -> identical request
@@ -1912,6 +1931,7 @@ def fleet_bench(smoke_mode=False):
                 round(p99_after / p99_before, 3) if p99_before else None
             ),
             "breaker_cycle": victim_cycle,
+            "admission": stats["admission"],
             "breakers": stats["breakers"],
             "health_transitions": stats["health"]["transitions"],
             "zombie_beats": stats["health"]["zombie_beats"],
@@ -2098,6 +2118,23 @@ def smoke():
         problems.append(
             f"no spill prefetch hits in counters {sorted(counters)}"
         )
+    # unified-plan schema: every roundtrip-streamed artifact now stamps
+    # the compiled plan (inputs hash, pass grid, spill policy, predicted
+    # vs measured wall) — drift fails here, on CPU, in seconds
+    from swiftly_tpu.obs import validate_plan_artifact
+
+    problems.extend(validate_plan_artifact(record))
+    pc = record.get("plan_compiled") or {}
+    bwd_plan = record.get("bwd_plan") or {}
+    if (pc.get("backward") or {}).get("n_passes") != bwd_plan.get(
+        "n_passes"
+    ):
+        problems.append(
+            f"compiled plan n_passes {pc.get('backward')} disagrees "
+            f"with the executed bwd_plan {bwd_plan}"
+        )
+    if "measured_wall_s" not in pc:
+        problems.append("plan_compiled missing measured_wall_s")
     import json as _json
 
     with open(jsonl_path) as fh:

@@ -31,9 +31,21 @@ Accepted file shapes: a single BENCH record, a list of records, a
 JSONL of records (``BENCH_partial.jsonl``), or the round-ledger shape
 ``{"parsed": record}`` of ``BENCH_r0*.json``.
 
+Since the unified plan compiler (PR 7), records may also carry a
+``plan_compiled`` block with a predicted wall next to the measured one.
+A calibrated plan (``coeffs_source == "measured"``) whose predicted and
+measured walls diverge more than ``--plan-threshold`` x (default 2x) is
+**flagged as mispriced** — a mispriced cost model quietly produces bad
+plans on every future run, which is a regression in its own right.
+Uncalibrated (default-coefficient) predictions are reported but never
+flagged: a CPU smoke run racing TPU-anchored defaults is a category
+error, like the cross-platform wall comparison above. Mispricing flips
+the exit code only under ``--fail-on-mispriced``.
+
 Usage:
     python scripts/bench_compare.py BENCH_smoke.json \
-        --against 'BENCH_r0*.json' [--threshold 0.2] [--json]
+        --against 'BENCH_r0*.json' [--threshold 0.2] [--json] \
+        [--plan-threshold 2.0] [--fail-on-mispriced]
 
 Exit: 0 ok / nothing comparable, 1 regression detected, 2 bad input.
 Wired into tier-1 via tests/test_bench_smoke.py (the smoke artifact is
@@ -228,6 +240,48 @@ def compare(latest_records, reference_records, threshold=0.2):
     }
 
 
+def plan_verdicts(latest_records, plan_threshold=2.0):
+    """Mispricing verdicts for every ``plan_compiled`` block that
+    carries both a predicted and a measured wall.
+
+    A CALIBRATED plan (``coeffs_source == "measured"``) whose ratio
+    falls outside [1/plan_threshold, plan_threshold] is ``mispriced``;
+    default-coefficient predictions are reported with
+    ``mispriced: False`` always (ranking anchors, not a contract)."""
+    verdicts = []
+    for rec in latest_records:
+        block = rec.get("plan_compiled")
+        if not isinstance(block, dict):
+            continue
+        predicted = (block.get("predicted") or {}).get("wall_s")
+        measured = block.get("measured_wall_s")
+        if not (
+            isinstance(predicted, (int, float))
+            and isinstance(measured, (int, float))
+            and predicted > 0
+            and measured > 0
+        ):
+            continue
+        key = leg_key(rec) or ("?", block.get("mode", "?"))
+        ratio = predicted / measured
+        calibrated = block.get("coeffs_source") == "measured"
+        verdicts.append(
+            {
+                "config": key[0],
+                "mode": key[1],
+                "coeffs_source": block.get("coeffs_source"),
+                "predicted_wall_s": predicted,
+                "measured_wall_s": measured,
+                "ratio": round(ratio, 3),
+                "mispriced": calibrated
+                and not (
+                    1.0 / plan_threshold <= ratio <= plan_threshold
+                ),
+            }
+        )
+    return verdicts
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="diff a BENCH artifact against baseline artifacts"
@@ -248,6 +302,16 @@ def main(argv=None):
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit the full report as one JSON object",
+    )
+    parser.add_argument(
+        "--plan-threshold", type=float, default=2.0,
+        help="flag a calibrated plan whose predicted/measured wall "
+             "ratio leaves [1/x, x] as mispriced (default 2.0)",
+    )
+    parser.add_argument(
+        "--fail-on-mispriced", action="store_true",
+        help="exit non-zero on a mispriced calibrated plan "
+             "(default: report only)",
     )
     args = parser.parse_args(argv)
 
@@ -276,6 +340,10 @@ def main(argv=None):
     )
     report["latest"] = args.latest
     report["reference_files"] = [path for path, _recs in reference]
+    report["plans"] = plan_verdicts(
+        latest, plan_threshold=args.plan_threshold
+    )
+    report["mispriced"] = [p for p in report["plans"] if p["mispriced"]]
     if args.as_json:
         print(json.dumps(report, indent=2))
     else:
@@ -298,9 +366,21 @@ def main(argv=None):
                 f"  skipped  {s['config']} ({s['mode']}, "
                 f"{s['platform']}): {s['reason']}"
             )
+        for p in report["plans"]:
+            status = "MISPRICED" if p["mispriced"] else "priced"
+            print(
+                f"{status:>9}  {p['config']} ({p['mode']}, "
+                f"{p['coeffs_source']} coeffs): predicted "
+                f"{p['predicted_wall_s']:.4g}s vs measured "
+                f"{p['measured_wall_s']:.4g}s (x{p['ratio']})"
+            )
         if not report["legs"] and not report["skipped"]:
             print("nothing comparable (no matching legs)")
-    return 1 if report["regressions"] else 0
+    if report["regressions"]:
+        return 1
+    if report["mispriced"] and args.fail_on_mispriced:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,145 @@
+"""Print the compiled plan for a config: what was chosen, what it
+costs, and what was rejected.
+
+The unified plan compiler (`swiftly_tpu.plan`) prices a cover's
+geometry — backward facet x row-slab pass grid, spill policy, serve
+bucket shapes, forward grouping prediction — from one cost model, with
+no device needed. This CLI is the operator window into that choice:
+
+    python scripts/plan_explain.py --config 64k
+    python scripts/plan_explain.py --config 128k[1]-n32k-512 \
+        --hbm-gib 16 --history 'BENCH_r0*.json' [--json]
+
+``--config`` accepts a catalogue prefix (``64k`` resolves to the first
+``64k[...`` catalogue entry — the paper's W=11 family) or a full name.
+``--hbm-gib`` defaults to the SWIFTLY_HBM_BUDGET env / probed device
+capacity (`plan.hbm_budget_bytes`) — pass it explicitly to plan for a
+machine you are not on. ``--history`` globs feed `plan.autotune.refit`:
+with measured per-stage telemetry the compiler picks parameters (e.g.
+the fold group) by predicted wall and the report shows the refit
+coefficients; without it the static defaults only RANK alternatives
+and the seed heuristics keep the choice.
+
+Exit: 0 on a printed plan, 2 on a bad config/inputs.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def resolve_config(name):
+    """Exact catalogue name, else the first entry starting ``name[``."""
+    from swiftly_tpu.models import SWIFT_CONFIGS
+
+    if name in SWIFT_CONFIGS:
+        return name
+    for key in SWIFT_CONFIGS:
+        if key.startswith(f"{name}["):
+            return key
+    raise KeyError(
+        f"config {name!r} matches nothing in the catalogue "
+        f"({len(SWIFT_CONFIGS)} entries; try e.g. "
+        f"{next(iter(SWIFT_CONFIGS))!r})"
+    )
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="print the unified plan compiler's choice for a "
+                    "config (pass grid, spill policy, serve shapes, "
+                    "predicted wall/HBM peak, rejected alternatives)"
+    )
+    ap.add_argument(
+        "--config", default="64k",
+        help="catalogue name or prefix (default 64k -> the first "
+             "64k[... entry)",
+    )
+    ap.add_argument(
+        "--mode", default="roundtrip-streamed",
+        choices=["streamed", "roundtrip-streamed"],
+        help="which pipeline to price (default roundtrip-streamed)",
+    )
+    ap.add_argument(
+        "--hbm-gib", type=float, default=None,
+        help="per-device HBM budget in GiB (default: SWIFTLY_HBM_BUDGET "
+             "env / probed device, unlimited on CPU)",
+    )
+    ap.add_argument(
+        "--devices", type=int, default=1,
+        help="device count for the mesh-layout stub (default 1)",
+    )
+    ap.add_argument(
+        "--fold-group", type=int, default=2,
+        help="seed fold group (default 2, bench's BENCH_FOLD_GROUP)",
+    )
+    ap.add_argument(
+        "--max-batch", type=int, default=64,
+        help="serve coalescing cap for the bucket shapes (default 64)",
+    )
+    ap.add_argument(
+        "--history", action="append", default=[], metavar="GLOB",
+        help="artifact path/glob for plan.autotune.refit; repeatable. "
+             "Measured coefficients unlock parameter selection by "
+             "predicted wall",
+    )
+    ap.add_argument(
+        "--spill-dir", default=None,
+        help="spill directory the policy may assume (default: "
+             "SWIFTLY_SPILL_DIR)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the plan's artifact block as JSON instead of the "
+             "human report",
+    )
+    args = ap.parse_args(argv)
+
+    from swiftly_tpu.plan import (
+        PlanInputs,
+        compile_plan,
+        hbm_budget_bytes,
+        refit,
+    )
+
+    try:
+        name = resolve_config(args.config)
+    except KeyError as exc:
+        print(exc.args[0], file=sys.stderr)
+        return 2
+    budget = (
+        args.hbm_gib * 2.0 ** 30
+        if args.hbm_gib is not None
+        else hbm_budget_bytes()
+    )
+    inputs = PlanInputs.from_config(
+        name, hbm_budget=budget, n_devices=args.devices,
+        fold_group=args.fold_group, max_batch=args.max_batch,
+    )
+    coeffs = refit(args.history) if args.history else None
+    plan = compile_plan(
+        inputs, coeffs=coeffs, mode=args.mode,
+        spill_dir=args.spill_dir,
+    )
+    if args.as_json:
+        print(json.dumps(plan.artifact_block(), indent=2))
+        return 0
+    print(plan.explain())
+    if coeffs is not None:
+        print(
+            f"  coefficients: {coeffs.source} "
+            f"({coeffs.n_records} record(s), platform "
+            f"{coeffs.platform or '?'})"
+        )
+        for stage, rate in sorted(coeffs.flops_per_s.items()):
+            print(f"    {stage}: {rate / 1e12:.2f} TF/s")
+        for stage, rate in sorted(coeffs.bytes_per_s.items()):
+            print(f"    {stage}: {rate / 1e9:.2f} GB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

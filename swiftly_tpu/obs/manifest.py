@@ -29,12 +29,14 @@ __all__ = [
     "BASELINE_SOURCES",
     "FLEET_ARTIFACT_FIELDS",
     "MANIFEST_SCHEMA",
+    "PLAN_ARTIFACT_FIELDS",
     "RESILIENCE_ARTIFACT_FIELDS",
     "SERVE_ARTIFACT_FIELDS",
     "config_hash",
     "run_manifest",
     "validate_artifact",
     "validate_fleet_artifact",
+    "validate_plan_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
 ]
@@ -382,6 +384,111 @@ def validate_fleet_artifact(record):
             not isinstance(v, (int, float)) or v < 0
         ):
             problems.append(f"{field} {v!r} is not a latency")
+    return problems
+
+
+# The compiled-plan block streamed/roundtrip bench artifacts stamp
+# (`swiftly_tpu.plan.Plan.artifact_block`) — the plan compiler's schema
+# contract: which inputs were priced (hash), the chosen pass grid /
+# spill policy / serve shapes, and predicted vs measured wall so a
+# mispriced model (future bad plans) is visible in the artifact itself.
+PLAN_ARTIFACT_FIELDS = (
+    "inputs_hash",
+    "mode",
+    "backward",
+    "spill",
+    "serve",
+    "mesh",
+    "predicted",
+    "coeffs_source",
+)
+
+_PLAN_BACKWARD_FIELDS = (
+    "n_passes", "n_facet_passes", "n_row_slabs", "fold_group",
+    "resident_bytes",
+)
+
+_PLAN_SPILL_MODES = ("none", "ram", "disk", "replay")
+
+
+def validate_plan_artifact(record):
+    """Problems with an artifact's ``plan_compiled`` block, as strings.
+
+    The block must carry the pricing-inputs hash, a coherent backward
+    pass grid (``n_passes == n_facet_passes * n_row_slabs``), a known
+    spill mode, ascending serve bucket shapes, numeric predicted
+    wall/HBM peak, and a coefficient pedigree — so a plan nobody can
+    reprice (or a grid that disagrees with itself) fails in seconds on
+    CPU instead of silently producing bad plans later.
+    """
+    problems = []
+    block = record.get("plan_compiled")
+    if not isinstance(block, dict):
+        return ["missing plan_compiled block"]
+    for field in PLAN_ARTIFACT_FIELDS:
+        if field not in block:
+            problems.append(f"plan_compiled missing {field!r}")
+    if not block.get("inputs_hash"):
+        problems.append("plan_compiled inputs_hash is empty")
+    bwd = block.get("backward")
+    if isinstance(bwd, dict):
+        for field in _PLAN_BACKWARD_FIELDS:
+            if field not in bwd:
+                problems.append(f"plan backward block missing {field!r}")
+        n, nf, nr = (
+            bwd.get("n_passes"), bwd.get("n_facet_passes"),
+            bwd.get("n_row_slabs"),
+        )
+        if (
+            all(isinstance(v, int) for v in (n, nf, nr))
+            and n != nf * nr
+        ):
+            problems.append(
+                f"plan pass grid incoherent: {n} passes != "
+                f"{nf} facet passes x {nr} row slabs"
+            )
+    elif "backward" in block:
+        problems.append("plan backward block is not a dict")
+    spill = block.get("spill")
+    if isinstance(spill, dict):
+        if spill.get("mode") not in _PLAN_SPILL_MODES:
+            problems.append(
+                f"plan spill mode {spill.get('mode')!r} not in "
+                f"{_PLAN_SPILL_MODES}"
+            )
+    serve = block.get("serve")
+    if isinstance(serve, dict):
+        buckets = serve.get("bucket_sizes")
+        if not isinstance(buckets, list) or not buckets or any(
+            b2 <= b1 for b1, b2 in zip(buckets, buckets[1:])
+        ):
+            problems.append(
+                f"plan serve bucket_sizes {buckets!r} is not an "
+                "ascending non-empty list"
+            )
+    pred = block.get("predicted")
+    if isinstance(pred, dict):
+        for field in ("wall_s", "hbm_peak_bytes"):
+            v = pred.get(field)
+            if not isinstance(v, (int, float)) or v < 0:
+                problems.append(
+                    f"plan predicted.{field} {v!r} is not a "
+                    "non-negative number"
+                )
+    elif "predicted" in block:
+        problems.append("plan predicted block is not a dict")
+    if "measured_wall_s" in block and not isinstance(
+        block["measured_wall_s"], (int, float)
+    ):
+        problems.append(
+            f"plan measured_wall_s {block['measured_wall_s']!r} is "
+            "not a number"
+        )
+    if block.get("coeffs_source") not in (None, "default", "measured"):
+        problems.append(
+            f"plan coeffs_source {block.get('coeffs_source')!r} not "
+            "default|measured"
+        )
     return problems
 
 

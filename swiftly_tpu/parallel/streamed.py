@@ -3115,25 +3115,17 @@ class StreamedForward:
     def _hbm_budget(self):
         """Per-device HBM budget in bytes (None = unlimited, e.g. CPU).
 
-        SWIFTLY_HBM_BUDGET (bytes) if set, else the USABLE capacity from
-        `utils.profiling.probe_hbm_bytes` (runtime-reported memory_stats
-        when available, else a measured per-device-kind table — margins
-        applied inside the probe), else 14e9 as a last resort.
-        """
-        import os
+        Delegates to the unified parser `plan.hbm_budget_bytes`
+        (SWIFTLY_HBM_BUDGET if set, else the usable capacity from
+        `utils.profiling.probe_hbm_bytes`, else 14e9 as a last resort);
+        the executor keeps its historical CPU-is-unlimited semantics
+        (``honor_env_on_cpu=False``)."""
+        from ..plan.model import hbm_budget_bytes
 
-        import jax
-
-        device = jax.devices()[0]
-        if device.platform == "cpu":
-            return None
-        env = os.environ.get("SWIFTLY_HBM_BUDGET")
-        if env:
-            return float(env) - self.hbm_headroom
-        from ..utils.profiling import probe_hbm_bytes
-
-        limit = probe_hbm_bytes(device) or 14e9
-        return limit - self.hbm_headroom
+        return hbm_budget_bytes(
+            headroom=self.hbm_headroom, default=14e9,
+            honor_env_on_cpu=False,
+        )
 
     def _facet_stack_fits(self):
         """Whether the whole facet stack can stay device-resident with

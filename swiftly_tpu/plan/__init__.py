@@ -1,0 +1,68 @@
+"""Unified plan compiler: one cost model for geometry, memory, schedule.
+
+Three parts (ROADMAP item 4):
+
+* ``plan.model`` — the declarative cost model: `PlanInputs` (N,
+  facet/subgrid geometry, dtype, HBM budget, device count) priced into
+  per-stage bytes/FLOPs/estimated wall via the same `utils.flops`
+  formulas the obs instrumentation attributes with, plus the shared
+  helpers the old forks each re-implemented (`hbm_budget_bytes`,
+  `bucket_sizes`, the serve admission byte projections).
+* ``plan.compiler`` — `compile_plan()` searches the model and emits one
+  executable `Plan`: the backward facet x row-slab pass grid, the spill
+  policy (RAM/disk/replay), serve bucket shapes + admission pricing,
+  and a mesh-layout stub for the multi-chip arc. bench.py, the
+  coalescing scheduler, the spill cache and the serve fleet are its
+  consumers; seed-geometry plans are pinned equivalent to the old
+  heuristics by tests/test_128k.py.
+* ``plan.autotune`` — `refit(history)` reads provenance-stamped
+  artifact history (PR-1 manifests + per-stage telemetry, PR-5 trace
+  self-time) into measured per-stage throughput coefficients;
+  `compile_plan(..., history=...)` then picks e.g. fold groups and
+  slab counts from measured walls instead of static constants.
+
+`scripts/plan_explain.py` prints a chosen plan, its predicted wall/HBM
+peak and the rejected alternatives; see docs/planning.md.
+"""
+
+from . import autotune, compiler, model
+from .autotune import load_history, refit
+from .compiler import (
+    BackwardPlan,
+    MeshLayout,
+    Plan,
+    ServePlan,
+    SpillPolicy,
+    compile_plan,
+    plan_backward_passes,
+)
+from .model import (
+    CostCoefficients,
+    PlanInputs,
+    bucket_shape,
+    bucket_sizes,
+    hbm_budget_bytes,
+    projected_column_bytes,
+    projected_request_bytes,
+)
+
+__all__ = [
+    "BackwardPlan",
+    "CostCoefficients",
+    "MeshLayout",
+    "Plan",
+    "PlanInputs",
+    "ServePlan",
+    "SpillPolicy",
+    "autotune",
+    "bucket_shape",
+    "bucket_sizes",
+    "compile_plan",
+    "compiler",
+    "hbm_budget_bytes",
+    "load_history",
+    "model",
+    "plan_backward_passes",
+    "projected_column_bytes",
+    "projected_request_bytes",
+]

@@ -1,0 +1,146 @@
+"""Measured-feedback autotune: refit the cost model from run history.
+
+Every bench artifact since PR 1 is provenance-stamped (manifest: device
+platform/kind, env, config hash) and carries per-stage telemetry —
+``telemetry.stages[<name>]`` with ``total_s`` plus the analytic
+``flops`` / moved ``bytes`` the obs instrumentation attributed — and,
+since PR 5, a ``trace`` block whose self-time attribution partitions
+the leg wall. That history is exactly a measured throughput table:
+
+    rate(stage) = sum(flops) / sum(total_s)          (compute stages)
+    rate(stage) = sum(bytes) / sum(total_s)          (transfer stages)
+
+`refit(history)` folds the matching records into `CostCoefficients`
+with ``source = "measured"``, which is what unlocks parameter selection
+in `compiler.compile_plan` — e.g. the backward fold group is then
+picked by predicted wall (dispatch count vs fold-pipeline residency vs
+spill re-reads) instead of the static default. Records from a
+different platform than requested are skipped, not averaged: a CPU
+smoke artifact must never calibrate a TPU plan.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+
+from .model import CostCoefficients
+
+__all__ = ["load_history", "refit"]
+
+logger = logging.getLogger(__name__)
+
+
+def load_history(patterns):
+    """BENCH records from artifact files (JSON record/list/JSONL or the
+    round-ledger ``{"parsed": ...}`` shape), for `refit`.
+
+    :param patterns: path/glob strings (or one string)
+    """
+    if isinstance(patterns, (str, bytes)):
+        patterns = [patterns]
+    records = []
+    for pattern in patterns:
+        for path in sorted(glob.glob(str(pattern))):
+            try:
+                text = open(path).read()
+            except OSError as exc:
+                logger.warning("history: cannot read %s: %s", path, exc)
+                continue
+            try:
+                data = json.loads(text)
+            except json.JSONDecodeError:
+                try:
+                    data = [
+                        json.loads(line)
+                        for line in text.splitlines()
+                        if line.strip()
+                    ]
+                except json.JSONDecodeError:
+                    logger.warning("history: %s is not JSON/JSONL", path)
+                    continue
+            if isinstance(data, dict) and "parsed" in data:
+                data = data["parsed"]
+            if isinstance(data, dict):
+                data = [data]
+            records.extend(r for r in data if isinstance(r, dict))
+    return records
+
+
+def _record_platform(record):
+    manifest = record.get("manifest") or {}
+    return (manifest.get("device") or {}).get("platform")
+
+
+def refit(history, platform=None, dispatch_s=None):
+    """Fit per-stage throughput coefficients from artifact history.
+
+    :param history: records (dicts) or paths/globs (`load_history`)
+    :param platform: only fold in records stamped for this platform
+        (default: the first record's platform — mixing a CPU smoke into
+        a TPU fit would poison every rate)
+    :param dispatch_s: override the per-dispatch latency floor (not
+        derivable from stage telemetry; measured ~0.1 s on the tunnel
+        runtime, scripts/roofline.py)
+    :return: `CostCoefficients` with ``source="measured"`` when at
+        least one stage was fit, else the defaults (``"default"``)
+    """
+    if history and all(
+        isinstance(h, (str, bytes)) for h in (
+            history if isinstance(history, (list, tuple)) else [history]
+        )
+    ):
+        history = load_history(history)
+    elif isinstance(history, dict):
+        history = [history]
+    history = [r for r in (history or []) if isinstance(r, dict)]
+    if platform is None:
+        for rec in history:
+            platform = _record_platform(rec)
+            if platform:
+                break
+    flops_acc = {}   # stage -> [flops, seconds]
+    bytes_acc = {}   # stage -> [bytes, seconds]
+    n_used = 0
+    for rec in history:
+        plat = _record_platform(rec)
+        if platform and plat and plat != platform:
+            continue
+        stages = (rec.get("telemetry") or {}).get("stages") or {}
+        used = False
+        for name, entry in stages.items():
+            total_s = entry.get("total_s") or 0.0
+            if total_s <= 0:
+                continue
+            if entry.get("flops"):
+                acc = flops_acc.setdefault(name, [0.0, 0.0])
+                acc[0] += entry["flops"]
+                acc[1] += total_s
+                used = True
+            if entry.get("bytes"):
+                acc = bytes_acc.setdefault(name, [0.0, 0.0])
+                acc[0] += entry["bytes"]
+                acc[1] += total_s
+                used = True
+        # PR-5 trace self-time blocks refine stages the registry missed
+        # (a stage with self-time but no flops attribution still tells
+        # us nothing about throughput, so only flops/bytes stages fit)
+        if used:
+            n_used += 1
+    if not n_used:
+        return CostCoefficients()
+    coeffs = CostCoefficients(
+        flops_per_s={
+            name: acc[0] / acc[1] for name, acc in flops_acc.items()
+        },
+        bytes_per_s={
+            name: acc[0] / acc[1] for name, acc in bytes_acc.items()
+        },
+        source="measured",
+        n_records=n_used,
+        platform=platform,
+    )
+    if dispatch_s is not None:
+        coeffs.dispatch_s = float(dispatch_s)
+    return coeffs

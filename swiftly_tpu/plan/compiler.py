@@ -1,0 +1,547 @@
+"""`compile_plan`: search the cost model, emit ONE executable plan.
+
+The plan is the single source for the four decisions that used to be
+priced independently (ROADMAP item 4):
+
+* the backward facet x output-row-slab pass grid
+  (`plan_backward_passes` — moved here verbatim from bench.py; bench
+  now delegates, and the 4k/32k/64k/128k golden tests pin equality);
+* the spill policy (RAM ring / disk backing / forward replay) for the
+  subgrid stream every backward pass consumes;
+* the serve batch shapes (power-of-two buckets under the coalescing
+  cap) and the admission byte projections;
+* the forward column/facet grouping PREDICTION (reusing the calibrated
+  `parallel.streamed` sizers through the geometry shim — the executors
+  keep making the binding choice at dispatch time, so a plan is
+  explainable without a device but never forks the transient
+  accounting).
+
+Plus a `MeshLayout` stub for the coming multi-chip arc: the mesh shape
+must fall out of the same model (arXiv 2002.03260), so the field exists
+now and records the single-device layout until the sharded engine
+consumes it.
+
+Selection policy: with DEFAULT coefficients the compiler keeps the seed
+heuristics' choices (provable equivalence); with MEASURED coefficients
+(`compile_plan(..., history=...)` -> `autotune.refit`) it picks e.g.
+the fold group by predicted wall, and records every evaluated
+alternative so `scripts/plan_explain.py` can show what was rejected and
+why.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from .model import (
+    CostCoefficients,
+    DEFAULT_FWD_MIN_BYTES,
+    DEFAULT_RESERVE_BYTES,
+    PlanInputs,
+    bucket_sizes,
+    price_backward,
+    price_forward,
+)
+
+__all__ = [
+    "BackwardPlan",
+    "MeshLayout",
+    "Plan",
+    "ServePlan",
+    "SpillPolicy",
+    "compile_plan",
+    "plan_backward_passes",
+]
+
+PLAN_SCHEMA = "swiftly-tpu-plan/1"
+
+# Fold groups the measured-coefficient search ranks (the seed default 2
+# is always among them; larger groups trade dispatch count against the
+# fold pipeline's resident rows, which is exactly the axis the history
+# can price).
+_FOLD_GROUP_CANDIDATES = (1, 2, 4, 8)
+
+
+def plan_backward_passes(
+    F_total, yB, per_facet_acc, per_facet_rows, fold_group, budget,
+    fwd_min=DEFAULT_FWD_MIN_BYTES, reserve=DEFAULT_RESERVE_BYTES,
+    n_facet_env=0, n_row_env=0,
+):
+    """Facet x output-row-slab partition plan for the sampled backward.
+
+    Returns ``(parts, resident_bytes)``: `parts` is the pass list
+    [(i0, i1, r0, r1), ...] — facet subset [i0, i1) x accumulator rows
+    [r0, r1) — and `resident_bytes` the largest pass's accumulator +
+    row-pipeline residency (what the forward's auto-sizers must leave
+    free, `fwd.hbm_headroom`).
+
+    Partition order: facets first (the 64k mechanism — single-facet
+    passes leave the shared subgrid stream the most headroom), then
+    output-row slabs within a facet once even ONE facet's accumulator
+    exceeds the per-pass budget (the 128k mechanism: one 45056^2 facet
+    is 16.2 GiB; the fold's "ri" index restricts trivially, see
+    `StreamedBackward(row_slab=...)`). Every pass consumes the SAME
+    subgrid stream, so with the spill cache the total cost is one
+    forward + len(parts) cache-fed backward passes.
+
+    (Moved verbatim from ``bench._plan_backward_passes``; bench
+    delegates here and tests/test_128k.py pins the equivalence.)
+
+    :param per_facet_acc: one facet's WHOLE [yB, yB] accumulator bytes
+    :param per_facet_rows: one facet's [m, yB] column-rows bytes (the
+        fold pipeline keeps 2*fold_group + 2 of these live per facet)
+    :param budget: per-device HBM bytes (None = unpartitioned, e.g. CPU)
+    :param n_facet_env / n_row_env: operator overrides
+        (BENCH_BWD_FACET_PASSES / BENCH_BWD_ROW_SLABS)
+    """
+    rows_resident = (2 * fold_group + 2) * per_facet_rows
+    usable = None if budget is None else budget - fwd_min - reserve
+    if n_facet_env:
+        n_parts = max(1, min(int(n_facet_env), F_total))
+    elif usable is None:
+        n_parts = 1
+    elif F_total * (per_facet_acc + rows_resident) <= usable:
+        n_parts = 1
+    else:
+        # once partitioning is forced, single-facet passes win: the
+        # stream feed dominates each pass and its sizing scales with
+        # the headroom the accumulator leaves (measured at 64k)
+        n_parts = F_total
+    F_sub = -(-F_total // n_parts)
+    n_row = 1
+    if n_row_env:
+        n_row = max(1, min(int(n_row_env), yB))
+    elif usable is not None and n_parts > 1:
+        per_pass = F_sub * (per_facet_acc + rows_resident)
+        if per_pass > usable:
+            # slab the accumulator; the column rows stay full-width
+            # (the fold consumes every row whatever slab it outputs)
+            acc_budget = usable - F_sub * rows_resident
+            per_row = max(1.0, F_sub * per_facet_acc / yB)
+            h = int(acc_budget // per_row) if acc_budget > 0 else 0
+            n_row = -(-yB // max(1, h))
+    row_h = -(-yB // n_row)
+    parts = [
+        (i0, min(i0 + F_sub, F_total), r0, min(r0 + row_h, yB))
+        for i0 in range(0, F_total, F_sub)
+        for r0 in range(0, yB, row_h)
+    ]
+    resident = max(
+        (i1 - i0) * (per_facet_acc * (r1 - r0) / yB + rows_resident)
+        for i0, i1, r0, r1 in parts
+    )
+    return parts, int(resident)
+
+
+# ---------------------------------------------------------------------------
+# Plan components
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BackwardPlan:
+    parts: list
+    fold_group: int
+    resident_bytes: int
+
+    @property
+    def n_passes(self):
+        return len(self.parts)
+
+    @property
+    def n_facet_passes(self):
+        return len({(p[0], p[1]) for p in self.parts})
+
+    @property
+    def n_row_slabs(self):
+        return len({(p[2], p[3]) for p in self.parts})
+
+    def as_dict(self):
+        return {
+            "n_passes": self.n_passes,
+            "n_facet_passes": self.n_facet_passes,
+            "n_row_slabs": self.n_row_slabs,
+            "fold_group": self.fold_group,
+            "resident_bytes": int(self.resident_bytes),
+        }
+
+
+@dataclass
+class SpillPolicy:
+    """Where the subgrid stream lives between backward passes."""
+
+    use_spill: bool
+    mode: str                 # "none" | "ram" | "disk" | "replay"
+    budget_bytes: int
+    stream_bytes: int
+    spill_dir: str | None = None
+
+    def as_dict(self):
+        return {
+            "use_spill": self.use_spill,
+            "mode": self.mode,
+            "budget_bytes": int(self.budget_bytes),
+            "stream_bytes": int(self.stream_bytes),
+            "disk_backed": self.spill_dir is not None,
+        }
+
+    def make_cache(self):
+        """A `SpillCache` budgeted per this policy (the fork the cache
+        used to price for itself)."""
+        from ..utils.spill import SpillCache
+
+        return SpillCache(
+            budget_bytes=self.budget_bytes, spill_dir=self.spill_dir,
+            policy=self.as_dict(),
+        )
+
+
+@dataclass
+class ServePlan:
+    """Serve-side shapes + admission pricing for this geometry."""
+
+    max_batch: int
+    bucket_sizes: list
+    request_bytes: int
+    column_bytes: int
+
+    def as_dict(self):
+        return {
+            "max_batch": self.max_batch,
+            "bucket_sizes": list(self.bucket_sizes),
+            "request_bytes": int(self.request_bytes),
+            "column_bytes": int(self.column_bytes),
+        }
+
+
+@dataclass
+class MeshLayout:
+    """Mesh-layout stub for the multi-chip arc (ROADMAP item 1).
+
+    The facet axis is the natural shard (every accumulation is a sum
+    over facets; arXiv 2002.03260) — the layout records how the plan
+    WOULD shard today, so the sharded engine becomes a consumer of this
+    field instead of growing its own heuristic. Until then
+    ``status: "stub"`` says no executor binds to it yet.
+    """
+
+    n_devices: int = 1
+    facet_shards: int = 1
+    axis: str = "facets"
+    status: str = "stub"
+
+    def as_dict(self):
+        return {
+            "n_devices": self.n_devices,
+            "facet_shards": self.facet_shards,
+            "axis": self.axis,
+            "status": self.status,
+        }
+
+
+@dataclass
+class Plan:
+    """One compiled, executable plan plus its self-description."""
+
+    inputs: PlanInputs
+    mode: str
+    backward: BackwardPlan
+    spill: SpillPolicy
+    serve: ServePlan
+    mesh: MeshLayout
+    forward: dict
+    predicted: dict
+    alternatives: list = field(default_factory=list)
+    coeffs_source: str = "default"
+
+    def artifact_block(self, measured_wall_s=None):
+        """The ``plan_compiled`` block bench artifacts stamp (validated
+        by `obs.validate_plan_artifact`)."""
+        block = {
+            "schema": PLAN_SCHEMA,
+            "inputs_hash": self.inputs.inputs_hash(),
+            "config": self.inputs.config_name,
+            "mode": self.mode,
+            "backward": self.backward.as_dict(),
+            "spill": self.spill.as_dict(),
+            "serve": self.serve.as_dict(),
+            "mesh": self.mesh.as_dict(),
+            "forward": dict(self.forward),
+            "predicted": dict(self.predicted),
+            "coeffs_source": self.coeffs_source,
+            "alternatives": list(self.alternatives),
+        }
+        if measured_wall_s is not None:
+            block["measured_wall_s"] = round(float(measured_wall_s), 4)
+            pred = self.predicted.get("wall_s") or 0
+            if pred and measured_wall_s:
+                block["predicted_vs_measured"] = round(
+                    pred / measured_wall_s, 3
+                )
+        return block
+
+    def explain(self):
+        """Human-readable plan report (scripts/plan_explain.py)."""
+        i = self.inputs
+        gib = 2.0 ** 30
+        lines = [
+            f"plan for {i.config_name or 'custom geometry'} "
+            f"({self.mode})",
+            f"  cover: N={i.N} facets={i.n_facets}x{i.yB} "
+            f"columns={i.n_columns} subgrids={i.n_subgrids}x{i.xA}",
+            f"  budget: "
+            + (
+                f"{i.hbm_budget / gib:.2f} GiB/device"
+                if i.hbm_budget
+                else "unlimited (CPU)"
+            )
+            + f" x {i.n_devices} device(s)",
+            f"  forward: {self.forward}",
+            f"  backward: {self.backward.n_passes} pass(es) = "
+            f"{self.backward.n_facet_passes} facet subset(s) x "
+            f"{self.backward.n_row_slabs} row slab(s), "
+            f"fold_group={self.backward.fold_group}, "
+            f"resident {self.backward.resident_bytes / gib:.2f} GiB",
+            f"  spill: {self.spill.mode} "
+            f"(stream {self.spill.stream_bytes / gib:.2f} GiB, "
+            f"budget {self.spill.budget_bytes / gib:.2f} GiB)",
+            f"  serve: buckets {self.serve.bucket_sizes} "
+            f"(request {self.serve.request_bytes} B, "
+            f"column {self.serve.column_bytes / 1e6:.1f} MB)",
+            f"  mesh: {self.mesh.as_dict()}",
+            f"  predicted wall: {self.predicted['wall_s']:.1f} s "
+            f"({self.coeffs_source} coefficients), HBM peak "
+            f"{self.predicted['hbm_peak_bytes'] / gib:.2f} GiB",
+        ]
+        stages = self.predicted.get("stages") or {}
+        for name, st in stages.items():
+            lines.append(f"    {name}: {st['wall_s']:.1f} s")
+        if self.alternatives:
+            lines.append("  rejected alternatives:")
+            for alt in self.alternatives:
+                if alt.get("chosen"):
+                    continue
+                lines.append(
+                    f"    fold_group={alt['fold_group']}: "
+                    f"{alt['n_passes']} passes "
+                    f"({alt['n_facet_passes']}x{alt['n_row_slabs']}), "
+                    f"predicted {alt['predicted_wall_s']:.1f} s"
+                )
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+
+def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
+             fwd_min, reserve):
+    """Predicted per-stage walls + totals for one candidate plan."""
+    stages = []
+    if mode in ("streamed", "roundtrip-streamed"):
+        stages += price_forward(inputs, coeffs)
+    if mode == "roundtrip-streamed":
+        stages += price_backward(
+            inputs, parts, fold_group, coeffs, spill_fed=use_spill
+        )
+    wall = sum(s.wall_s for s in stages)
+    resident = max(
+        (i1 - i0)
+        * (
+            inputs.per_facet_acc_bytes * (r1 - r0) / inputs.yB
+            + (2 * fold_group + 2) * inputs.per_facet_row_bytes
+        )
+        for i0, i1, r0, r1 in parts
+    ) if mode == "roundtrip-streamed" else 0
+    if mode == "roundtrip-streamed":
+        peak = resident + fwd_min + reserve
+    else:
+        peak = inputs.facet_stack_bytes + 3e9
+    if inputs.hbm_budget:
+        peak = min(peak, inputs.hbm_budget)
+    return {
+        "wall_s": round(wall, 3),
+        "hbm_peak_bytes": int(peak),
+        "stages": {s.name: s.as_dict() for s in stages},
+    }
+
+
+def _forward_prediction(inputs):
+    """Predicted forward grouping via the CALIBRATED streamed sizers
+    (geometry shim; the executors still bind the real choice)."""
+    from ..parallel.streamed import (
+        col_group_for_budget,
+        facet_stack_bytes,
+        grouped_col_group_for_budget,
+    )
+
+    base = inputs.base()
+    budget = inputs.hbm_budget
+    if budget is None:
+        return {"mode": "resident", "col_group": inputs.n_columns,
+                "facet_group": None}
+    if facet_stack_bytes(base, inputs.real_facets) + 3e9 <= budget:
+        G = col_group_for_budget(
+            base, budget, inputs.n_columns, real=inputs.real_facets
+        )
+        return {"mode": "resident", "col_group": G, "facet_group": None}
+    Fg = 1
+    slab_b = Fg * inputs.yB * inputs.yB * (
+        inputs.dtype_bytes if inputs.real_facets else inputs.per_el
+    )
+    depth = 1 if 2 * slab_b > 0.5 * budget else 2
+    G, chunk = max(
+        (
+            (max(1, (Gc // c) * c if Gc >= c else Gc), c)
+            for c in (4, 3, 2, 1)
+            for Gc in (
+                grouped_col_group_for_budget(
+                    base, budget, inputs.n_columns,
+                    inputs.subgrids_per_column, inputs.xA,
+                    inputs.real_facets, Fg, c, slab_depth=depth,
+                    warn=False,
+                ),
+            )
+        ),
+        key=lambda t: (t[0], t[1]),
+    )
+    return {"mode": "grouped", "col_group": G, "facet_group": Fg,
+            "chunk": chunk, "slab_depth": depth}
+
+
+def compile_plan(
+    inputs, history=None, coeffs=None, mode="roundtrip-streamed",
+    fwd_min=DEFAULT_FWD_MIN_BYTES, reserve=DEFAULT_RESERVE_BYTES,
+    n_facet_env=0, n_row_env=0, allow_spill=True,
+    spill_budget=None, spill_dir=None,
+):
+    """Search the cost model; emit one `Plan`.
+
+    :param inputs: `PlanInputs` (geometry + budget + device count)
+    :param history: artifact records (dicts or paths) for
+        `autotune.refit` — measured coefficients unlock parameter
+        selection by predicted wall; without history the seed
+        heuristics' choices are kept (provable equivalence)
+    :param coeffs: explicit `CostCoefficients` (overrides history)
+    :param n_facet_env / n_row_env: operator pass-grid overrides
+        (bench forwards BENCH_BWD_FACET_PASSES / BENCH_BWD_ROW_SLABS)
+    :param allow_spill: False forces the replay cost model (BENCH_SPILL=0)
+    :param spill_budget / spill_dir: spill-policy overrides; defaults
+        are `utils.spill.spill_budget_bytes()` and SWIFTLY_SPILL_DIR
+    """
+    if coeffs is None:
+        if history:
+            from .autotune import refit
+
+            coeffs = refit(history)
+        else:
+            coeffs = CostCoefficients()
+
+    def _passes(fold_group):
+        return plan_backward_passes(
+            inputs.n_facets, inputs.yB, inputs.per_facet_acc_bytes,
+            inputs.per_facet_row_bytes, fold_group, inputs.hbm_budget,
+            fwd_min=fwd_min, reserve=reserve,
+            n_facet_env=n_facet_env, n_row_env=n_row_env,
+        )
+
+    # spill policy resolution happens BEFORE the candidate search: a
+    # stream too large for the cache budget (and with no disk backing)
+    # replays the forward per pass, and that cost difference is exactly
+    # what the fold-group ranking must see
+    if spill_budget is None:
+        from ..utils.spill import spill_budget_bytes
+
+        spill_budget = spill_budget_bytes()
+    if spill_dir is None:
+        spill_dir = os.environ.get("SWIFTLY_SPILL_DIR") or None
+
+    def _spill_mode(parts):
+        if not (allow_spill and len(parts) > 1):
+            return "none"
+        if inputs.stream_bytes <= spill_budget:
+            return "ram"
+        if spill_dir:
+            return "disk"
+        return "replay"
+
+    # -- fold-group search (the measured-feedback lever) ---------------------
+    candidates = sorted(
+        {inputs.fold_group}
+        | {
+            fg for fg in _FOLD_GROUP_CANDIDATES
+            if fg <= max(1, inputs.n_columns)
+        }
+    )
+    alternatives = []
+    best = None
+    for fg in candidates:
+        parts_c, resident_c = _passes(fg)
+        use_spill_c = _spill_mode(parts_c) in ("ram", "disk")
+        pred_c = _predict(inputs, parts_c, fg, coeffs, mode,
+                          use_spill_c, fwd_min, reserve)
+        alt = {
+            "fold_group": fg,
+            "n_passes": len(parts_c),
+            "n_facet_passes": len({(p[0], p[1]) for p in parts_c}),
+            "n_row_slabs": len({(p[2], p[3]) for p in parts_c}),
+            "predicted_wall_s": pred_c["wall_s"],
+            "chosen": False,
+        }
+        alternatives.append(alt)
+        cand = (pred_c["wall_s"], fg, parts_c, resident_c, pred_c, alt)
+        if best is None or cand[0] < best[0]:
+            best = cand
+    if coeffs.source == "measured" and mode == "roundtrip-streamed":
+        _wall, fold_group, parts, resident, predicted, chosen_alt = best
+    else:
+        # default coefficients: keep the seed heuristic's fold group —
+        # equivalence first, the model only ranks
+        fold_group = inputs.fold_group
+        parts, resident = _passes(fold_group)
+        predicted = _predict(
+            inputs, parts, fold_group, coeffs, mode,
+            _spill_mode(parts) in ("ram", "disk"), fwd_min, reserve,
+        )
+        chosen_alt = next(
+            a for a in alternatives if a["fold_group"] == fold_group
+        )
+    chosen_alt["chosen"] = True
+
+    # -- spill policy --------------------------------------------------------
+    spill_mode = _spill_mode(parts)
+    use_spill = spill_mode in ("ram", "disk")
+    spill = SpillPolicy(
+        use_spill=use_spill, mode=spill_mode,
+        budget_bytes=int(spill_budget),
+        stream_bytes=int(inputs.stream_bytes), spill_dir=spill_dir,
+    )
+
+    # -- serve shapes + admission pricing ------------------------------------
+    serve = ServePlan(
+        max_batch=inputs.max_batch,
+        bucket_sizes=bucket_sizes(inputs.max_batch),
+        request_bytes=inputs.xA * inputs.xA * inputs.per_el,
+        column_bytes=inputs.n_facets * inputs.m * inputs.yN
+        * inputs.per_el,
+    )
+
+    mesh = MeshLayout(
+        n_devices=inputs.n_devices,
+        facet_shards=min(inputs.n_devices, inputs.n_facets),
+    )
+
+    return Plan(
+        inputs=inputs,
+        mode=mode,
+        backward=BackwardPlan(parts, fold_group, resident),
+        spill=spill,
+        serve=serve,
+        mesh=mesh,
+        forward=_forward_prediction(inputs),
+        predicted=predicted,
+        alternatives=alternatives,
+        coeffs_source=coeffs.source,
+    )

@@ -299,6 +299,17 @@ class ServeFleet:
     :param seed: seeds the breakers' reopen jitter (deterministic
         drills)
     :param clock: injectable monotonic clock (tests drive `tick(now)`)
+    :param hbm_budget_bytes: fleet-wide projected-HBM admission cap
+        (None disables). Each pending request prices
+        ``request_bytes`` and each distinct pending column per replica
+        prices ``column_bytes`` — the unified plan compiler's serve
+        pricing (`plan.compile_plan(...).serve`); a submission whose
+        projection would cross the cap is shed at the fleet door with
+        a structured ``retry_after_s``, before any replica queue is
+        touched.
+    :param request_bytes / column_bytes: the admission cost model
+        (typically ``plan.serve.request_bytes`` /
+        ``plan.serve.column_bytes``)
     """
 
     def __init__(self, replica_factory, n_replicas=3, *,
@@ -310,10 +321,14 @@ class ServeFleet:
                  brownout_min_priority=1, brownout_escalate_s=0.25,
                  failover_backoff_s=0.01, failover_backoff_max_s=0.5,
                  supervise_interval_s=0.002, poll_s=0.001, seed=0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, hbm_budget_bytes=None,
+                 request_bytes=0, column_bytes=0):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self._clock = clock
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.request_bytes = int(request_bytes)
+        self.column_bytes = int(column_bytes)
         self.hedge_budget_s = hedge_budget_s
         self.hedge_factor = float(hedge_factor)
         self.hedge_min_s = float(hedge_min_s)
@@ -352,7 +367,7 @@ class ServeFleet:
             "requests": 0, "served": 0, "shed": 0, "expired": 0,
             "quarantined": 0, "failovers": 0, "reroutes": 0,
             "hedges": 0, "hedge_wins": 0, "route_faults": 0,
-            "brownout_sheds": 0, "restores": 0,
+            "brownout_sheds": 0, "hbm_sheds": 0, "restores": 0,
         }
         self._lat = []
         self._lat_i = 0
@@ -452,6 +467,27 @@ class ServeFleet:
             freq._complete(
                 RequestResult(
                     STATUS_SHED, shed_reason="brownout",
+                    retry_after_s=self._brownout_retry_hint(),
+                ),
+                now,
+            )
+            return freq
+        if (
+            self.hbm_budget_bytes is not None
+            and self.projected_fleet_bytes(off0=freq.config.off0)
+            > self.hbm_budget_bytes
+        ):
+            # fleet-wide admission cost cap: the serving-time analogue
+            # of the streamed executors' HBM-budgeted sizing, priced
+            # by the plan compiler's serve block
+            self._counts["hbm_sheds"] += 1
+            self._counts["shed"] += 1
+            _metrics.count("fleet.hbm_sheds")
+            _trace.instant("fleet.hbm_shed", cat="fleet",
+                           request_id=freq.req_id)
+            freq._complete(
+                RequestResult(
+                    STATUS_SHED, shed_reason="hbm",
                     retry_after_s=self._brownout_retry_hint(),
                 ),
                 now,
@@ -711,6 +747,28 @@ class ServeFleet:
             len(r.service.queue) for r in self._replicas.values()
         )
 
+    def projected_fleet_bytes(self, off0=None):
+        """Projected device cost of everything pending fleet-wide,
+        priced by the plan compiler's admission model: pending requests
+        x ``request_bytes`` plus each replica's distinct pending
+        columns x ``column_bytes``. ``off0`` adds the cost of one more
+        request for that column (the admission probe)."""
+        total = 0
+        extra_col = off0 is not None
+        for replica in self._replicas.values():
+            if replica.dead or replica.lease.revoked:
+                continue
+            cols = replica.service.queue.columns()
+            total += sum(c.count for c in cols) * self.request_bytes
+            total += len(cols) * self.column_bytes
+            if extra_col and any(c.off0 == off0 for c in cols):
+                extra_col = False  # column already priced somewhere
+        if off0 is not None:
+            total += self.request_bytes
+            if extra_col:
+                total += self.column_bytes
+        return total
+
     def _brownout_retry_hint(self):
         hints = [
             r.service.queue.retry_after_hint()
@@ -889,6 +947,13 @@ class ServeFleet:
                 "level": self._brownout_level,
                 "sheds": self._counts["brownout_sheds"],
                 "events": list(self._brownout_events),
+            },
+            "admission": {
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "request_bytes": self.request_bytes,
+                "column_bytes": self.column_bytes,
+                "hbm_sheds": self._counts["hbm_sheds"],
+                "projected_bytes": self.projected_fleet_bytes(),
             },
             "breakers": {
                 str(rid): r.breaker.stats()

@@ -38,16 +38,9 @@ for one fused dispatch over several columns.
 from __future__ import annotations
 
 from ..api import _group_columns, _pad_ragged_columns
+from ..plan.model import bucket_shape as _bucket
 
 __all__ = ["CoalescingScheduler"]
-
-
-def _bucket(n):
-    """Next power of two >= n (the compile-shape bucket)."""
-    b = 1
-    while b < n:
-        b *= 2
-    return b
 
 
 class CoalescingScheduler:
@@ -55,19 +48,28 @@ class CoalescingScheduler:
 
     :param max_batch: cap on requests per column dispatch (overflow
         stays queued for the next pump)
-    :param bucket_pad: pad batches to power-of-two sizes to bound the
+    :param bucket_pad: pad batches to bucketed sizes to bound the
         number of compiled program shapes
     :param urgency_s: deadline head-start — a column holding a request
         due within this many seconds preempts locality/density order;
         None disables deadline preemption
+    :param bucket_sizes: explicit ascending dispatch shapes (e.g. a
+        compiled plan's ``serve.bucket_sizes``); None keeps the
+        power-of-two default (`plan.model.bucket_shape` — the single
+        definition the old local ``_bucket`` fork duplicated)
     """
 
-    def __init__(self, max_batch=64, bucket_pad=True, urgency_s=None):
+    def __init__(self, max_batch=64, bucket_pad=True, urgency_s=None,
+                 bucket_sizes=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.max_batch = int(max_batch)
         self.bucket_pad = bool(bucket_pad)
         self.urgency_s = urgency_s
+        self.bucket_sizes = (
+            None if bucket_sizes is None
+            else sorted(int(b) for b in bucket_sizes)
+        )
 
     # -- column selection ---------------------------------------------------
 
@@ -122,7 +124,14 @@ class CoalescingScheduler:
         configs = [r.config for r in requests]
         n_pad = 0
         if self.bucket_pad and len(configs) > 1:
-            target = min(_bucket(len(configs)), self.max_batch)
+            if self.bucket_sizes is not None:
+                target = next(
+                    (b for b in self.bucket_sizes if b >= len(configs)),
+                    self.bucket_sizes[-1],
+                )
+                target = min(target, self.max_batch)
+            else:
+                target = min(_bucket(len(configs)), self.max_batch)
             n_pad = max(0, target - len(configs))
             configs = configs + [configs[0]] * n_pad
         return configs, n_pad

@@ -51,8 +51,6 @@ import logging
 import threading
 import time
 
-import numpy as np
-
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..resilience import degrade as _degrade
@@ -78,26 +76,14 @@ log = logging.getLogger("swiftly-tpu.serve")
 _LATENCY_RING = 65536  # newest-wins latency samples kept for quantiles
 
 
-def _per_element_bytes(core):
-    return np.dtype(core.dtype).itemsize * (
-        2 if core.backend == "planar" else 1
-    )
-
-
-def projected_request_bytes(config):
-    """Projected HBM bytes of one finished subgrid (queue cost model)."""
-    return config.max_subgrid_size ** 2 * _per_element_bytes(config.core)
-
-
-def projected_column_bytes(fwd):
-    """Projected HBM bytes of one column's intermediates — the
-    [F, m, yN] ``extract_columns_batch`` product a pending column will
-    materialise (queue cost model)."""
-    core = fwd.core
-    return (
-        len(fwd.stack) * core.xM_yN_size * core.yN_size
-        * _per_element_bytes(core)
-    )
+# The admission cost model moved into the unified plan compiler
+# (`plan.model` — one pricing shared with the fleet's fleet-wide
+# admission cap and `compile_plan`'s serve block); these names stay as
+# the serve-facing aliases.
+from ..plan.model import (  # noqa: E402 - after the docstring's imports
+    projected_column_bytes,
+    projected_request_bytes,
+)
 
 
 def _quantile(sorted_samples, q):

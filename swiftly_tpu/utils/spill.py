@@ -91,15 +91,20 @@ class SpillCache:
     :param spill_dir: directory for over-budget entries; default
         ``SWIFTLY_SPILL_DIR``; None disables disk backing (over-budget
         entries are evicted and the fill gives up)
+    :param policy: the compiled plan's spill-policy dict
+        (`plan.SpillPolicy.as_dict`) when this cache was budgeted by
+        `compile_plan` — recorded verbatim in `stats()` so artifacts
+        show which plan priced the cache (None for self-budgeted use)
     """
 
-    def __init__(self, budget_bytes=None, spill_dir=None):
+    def __init__(self, budget_bytes=None, spill_dir=None, policy=None):
         self.budget_bytes = (
             spill_budget_bytes() if budget_bytes is None else float(budget_bytes)
         )
         if spill_dir is None:
             spill_dir = os.environ.get("SWIFTLY_SPILL_DIR") or None
         self.spill_dir = spill_dir
+        self.policy = dict(policy) if policy else None
         self._own_dir = None  # created lazily under spill_dir
         self._entries = []  # ("ram", ndarray) | ("disk", path)
         self._meta = []
@@ -271,7 +276,7 @@ class SpillCache:
 
     def stats(self):
         """JSON-ready summary for bench artifacts."""
-        return {
+        out = {
             "entries": len(self._entries),
             "complete": self.complete,
             "ram_bytes": int(self.ram_bytes),
@@ -280,6 +285,9 @@ class SpillCache:
             "disk_backed": self.spill_dir is not None,
             **self.counters,
         }
+        if self.policy is not None:
+            out["policy"] = dict(self.policy)
+        return out
 
     def _clear_entries(self):
         self._entries = []

@@ -240,6 +240,84 @@ def test_128k_roundtrip_row_slab_plan_constructible():
     )[0] == [(0, F_total, 0, yB)]
 
 
+def test_compile_plan_golden_seed_grids():
+    """GOLDEN plans: the unified compiler (`swiftly_tpu.plan`) must
+    reproduce the bench heuristic's facet x row-slab grid EXACTLY at
+    4k/32k/64k/128k catalogue geometry — the seed plans the four
+    pricing forks produced before the compiler existed. Pinned both
+    against `bench._plan_backward_passes` (same parts, same residency,
+    byte for byte) and against hard-coded grid shapes, so neither side
+    can drift and take the "equivalence" test with it."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from bench import _plan_backward_passes
+    from swiftly_tpu.models import SWIFT_CONFIGS
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+
+    budget, fwd_min, reserve = 16.0e9, 3.3e9, 1.2e9
+    golden = {
+        # config -> (n_facet_passes, n_row_slabs) on a 16 GB budget
+        "4k[1]-n2k-512": (1, 1),
+        "32k[1]-n16k-512": (1, 1),
+        "64k[1]-n32k-512": (9, 1),   # the 64k mechanism: facet passes
+        "128k[1]-n32k-512": (9, 2),  # the 128k mechanism: + row slabs
+    }
+    for name, (want_f, want_r) in golden.items():
+        params = SWIFT_CONFIGS[name]
+        yB = params["yB_size"]
+        m = params["xM_size"] * params["yN_size"] // params["N"]
+        F_total = (-(-params["N"] // yB)) ** 2
+        per_el = 8  # planar f32 (re, im) — bench's roundtrip dtype
+        parts, resident = _plan_backward_passes(
+            F_total, yB, yB * yB * per_el, m * yB * per_el, 2, budget,
+            fwd_min=fwd_min, reserve=reserve,
+        )
+        plan = compile_plan(
+            PlanInputs.from_config(name, hbm_budget=budget),
+            fwd_min=fwd_min, reserve=reserve,
+        )
+        assert plan.backward.parts == parts, name
+        assert plan.backward.resident_bytes == resident, name
+        assert (
+            plan.backward.n_facet_passes, plan.backward.n_row_slabs
+        ) == (want_f, want_r), name
+        assert plan.backward.fold_group == 2, name  # seed choice kept
+        # unlimited budget (CPU): one whole pass, no spill
+        cpu = compile_plan(PlanInputs.from_config(name))
+        assert cpu.backward.parts == [(0, F_total, 0, yB)], name
+        assert cpu.spill.mode == "none", name
+        # operator overrides thread through identically
+        forced, _res = _plan_backward_passes(
+            F_total, yB, yB * yB * per_el, m * yB * per_el, 2, budget,
+            fwd_min=fwd_min, reserve=reserve, n_facet_env=3,
+            n_row_env=2,
+        )
+        forced_plan = compile_plan(
+            PlanInputs.from_config(name, hbm_budget=budget),
+            fwd_min=fwd_min, reserve=reserve, n_facet_env=3,
+            n_row_env=2,
+        )
+        assert forced_plan.backward.parts == forced, name
+
+
+def test_hbm_budget_bytes_single_parser(monkeypatch):
+    """`plan.hbm_budget_bytes` — THE SWIFTLY_HBM_BUDGET parse — keeps
+    both historical semantics: bench honors an explicit env budget even
+    on CPU (partitioned plans in CPU tests), the streamed executors
+    stay unlimited on CPU regardless (honor_env_on_cpu=False)."""
+    from swiftly_tpu.plan import hbm_budget_bytes
+
+    monkeypatch.setenv("SWIFTLY_HBM_BUDGET", "16e9")
+    assert hbm_budget_bytes() == 16.0e9
+    assert hbm_budget_bytes(headroom=1e9) == 15.0e9
+    # executor semantics on CPU: unlimited, env or not
+    assert hbm_budget_bytes(honor_env_on_cpu=False, default=14e9) is None
+    monkeypatch.delenv("SWIFTLY_HBM_BUDGET")
+    assert hbm_budget_bytes() is None  # CPU, no env -> unlimited
+
+
 def test_128k_proxy_row_slab_roundtrip_dryrun():
     """Dryrun validation of the row-slab round trip AT 128k GEOMETRY
     (N=131072, the full boundary yN=65536) on the CPU proxy: a partial

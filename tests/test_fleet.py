@@ -458,6 +458,34 @@ def test_fleet_all_replicas_shed_returns_structured_shed():
     assert c.result.retry_after_s is not None
 
 
+def test_fleet_hbm_admission_cap_prices_and_sheds():
+    """The fleet-wide admission cap consumes the plan compiler's serve
+    pricing (`plan.compile_plan(...).serve`): pending requests price
+    ``request_bytes`` each plus ``column_bytes`` per distinct pending
+    column per replica; a submission whose projection crosses the cap
+    sheds at the fleet door, and draining the backlog re-admits."""
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2, hbm_budget_bytes=3_300,
+                        request_bytes=1_000, column_bytes=100)
+    a = fleet.submit(_Cfg(0), priority=1)   # projects 1100: admitted
+    b = fleet.submit(_Cfg(0), priority=1)   # column already priced
+    assert not a.done and not b.done
+    assert fleet.projected_fleet_bytes() == 2_100
+    c = fleet.submit(_Cfg(0), priority=1)   # 3100 <= cap: admitted
+    assert not c.done
+    d = fleet.submit(_Cfg(1), priority=1)   # 4200 (new column): shed
+    assert d.done and d.result.status == STATUS_SHED
+    assert d.result.shed_reason == "hbm"
+    st = fleet.stats()
+    assert st["admission"]["hbm_sheds"] == 1
+    assert st["admission"]["projected_bytes"] == 3_100
+    # draining the backlog frees the projection; the retry is admitted
+    for r in fleet.replicas.values():
+        r.service.pump()
+    assert fleet.projected_fleet_bytes() == 0
+    assert not fleet.submit(_Cfg(1), priority=1).done
+
+
 def test_fleet_brownout_ladder_and_recovery():
     clk = _Clock()
     fleet = _stub_fleet(clk, n=2, lease_interval_s=10.0,

@@ -327,3 +327,138 @@ def test_streamed_disabled_emits_nothing(global_registry):
     fwd.all_subgrids(sgs)
     exp = global_registry.export()
     assert exp["stages"] == {} and exp["counters"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Unified plan compiler: artifact schema + measured-feedback autotune
+# ---------------------------------------------------------------------------
+
+
+def _doctored_record(platform="cpu", fold_tf_s=2.0, col_tf_s=4.0):
+    """A provenance-stamped artifact record whose per-stage telemetry
+    encodes known throughputs (flops / total_s), as `autotune.refit`
+    reads them."""
+    return {
+        "metric": "doctored leg", "value": 10.0,
+        "manifest": {"device": {"platform": platform}},
+        "telemetry": {
+            "stages": {
+                "bwd.sampled_fold": {
+                    "total_s": 10.0, "flops": fold_tf_s * 1e12 * 10.0,
+                },
+                "bwd.column_pass": {
+                    "total_s": 10.0, "flops": col_tf_s * 1e12 * 10.0,
+                },
+                "spill.h2d": {"total_s": 5.0, "bytes": 30e9},
+                "idle.untyped": {"total_s": 3.0},  # no flops: ignored
+            }
+        },
+    }
+
+
+def test_plan_autotune_refit_fits_measured_rates():
+    from swiftly_tpu.plan import refit
+
+    coeffs = refit([_doctored_record()])
+    assert coeffs.source == "measured" and coeffs.n_records == 1
+    assert coeffs.flops_per_s["bwd.sampled_fold"] == pytest.approx(2e12)
+    assert coeffs.flops_per_s["bwd.column_pass"] == pytest.approx(4e12)
+    assert coeffs.bytes_per_s["spill.h2d"] == pytest.approx(6e9)
+    assert "idle.untyped" not in coeffs.flops_per_s
+    # a record from ANOTHER platform must be skipped, not averaged —
+    # with nothing left the defaults come back unfit
+    assert refit([_doctored_record("tpu")], platform="cpu").source == (
+        "default"
+    )
+    # two same-platform records pool their (flops, seconds) sums
+    pooled = refit([_doctored_record(), _doctored_record(fold_tf_s=4.0)])
+    assert pooled.flops_per_s["bwd.sampled_fold"] == pytest.approx(3e12)
+
+
+def test_plan_autotune_changes_plan_parameter_from_history(tmp_path):
+    """The acceptance loop: doctored measured artifacts -> refit ->
+    `compile_plan(..., history=...)` picks a DIFFERENT fold group than
+    the seed heuristic, while the no-history plan provably keeps it."""
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+
+    inputs = PlanInputs.from_config(
+        "64k[1]-n32k-512", hbm_budget=16.0e9
+    )
+    seed = compile_plan(inputs)
+    assert seed.coeffs_source == "default"
+    assert seed.backward.fold_group == inputs.fold_group == 2
+    # history via an on-disk doctored artifact (the real read path,
+    # round-ledger shape included)
+    art = tmp_path / "BENCH_doctored.json"
+    art.write_text(json.dumps({"parsed": _doctored_record()}))
+    tuned = compile_plan(inputs, history=[str(art)])
+    assert tuned.coeffs_source == "measured"
+    assert tuned.backward.fold_group != seed.backward.fold_group
+    # the measured choice is the predicted-wall argmin of the ranked
+    # alternatives the plan records
+    best = min(tuned.alternatives, key=lambda a: a["predicted_wall_s"])
+    assert best["chosen"] and best["fold_group"] == (
+        tuned.backward.fold_group
+    )
+    # same grids either way at this geometry: only the parameter moved
+    assert tuned.backward.n_passes == seed.backward.n_passes
+
+
+def test_validate_plan_artifact():
+    from swiftly_tpu.obs import validate_plan_artifact
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+
+    plan = compile_plan(PlanInputs.from_config("4k[1]-n2k-512"))
+    record = {"plan_compiled": plan.artifact_block(measured_wall_s=1.5)}
+    assert validate_plan_artifact(record) == []
+    assert record["plan_compiled"]["predicted_vs_measured"] > 0
+    assert validate_plan_artifact({}) == ["missing plan_compiled block"]
+    # incoherent pass grid
+    bad = {"plan_compiled": dict(plan.artifact_block())}
+    bad["plan_compiled"]["backward"] = dict(
+        bad["plan_compiled"]["backward"], n_passes=7
+    )
+    assert any("incoherent" in p for p in validate_plan_artifact(bad))
+    # unknown spill mode
+    bad2 = {"plan_compiled": dict(plan.artifact_block())}
+    bad2["plan_compiled"]["spill"] = {"mode": "floppy"}
+    assert any("spill mode" in p for p in validate_plan_artifact(bad2))
+    # non-ascending serve buckets
+    bad3 = {"plan_compiled": dict(plan.artifact_block())}
+    bad3["plan_compiled"]["serve"] = {"bucket_sizes": [4, 2, 8]}
+    assert any("bucket_sizes" in p for p in validate_plan_artifact(bad3))
+    # coefficient pedigree must be stamped and known
+    bad4 = {"plan_compiled": dict(plan.artifact_block())}
+    bad4["plan_compiled"]["coeffs_source"] = "vibes"
+    assert any("coeffs_source" in p for p in validate_plan_artifact(bad4))
+
+
+def test_bench_compare_flags_mispriced_calibrated_plan():
+    """A calibrated (measured-coefficients) plan whose predicted and
+    measured walls diverge >2x is flagged; a default-coefficients
+    prediction never is (ranking anchor, not a contract)."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from scripts.bench_compare import plan_verdicts
+
+    def rec(source, predicted, measured):
+        return {
+            "metric": "x", "value": measured,
+            "plan_compiled": {
+                "coeffs_source": source,
+                "predicted": {"wall_s": predicted},
+                "measured_wall_s": measured,
+            },
+        }
+
+    out = plan_verdicts(
+        [
+            rec("measured", 50.0, 10.0),   # 5x over: mispriced
+            rec("measured", 2.0, 10.0),    # 5x under: mispriced
+            rec("measured", 15.0, 10.0),   # inside 2x: fine
+            rec("default", 50.0, 10.0),    # uncalibrated: never flagged
+        ]
+    )
+    assert [v["mispriced"] for v in out] == [True, True, False, False]

@@ -241,6 +241,58 @@ def test_bucket_padding_bounds_shapes():
     assert len(configs2) == 6 and n_pad2 == 1
 
 
+def test_scheduler_consumes_compiled_plan_buckets():
+    """A compiled plan's ``serve.bucket_sizes`` drives the batch shapes
+    (the scheduler's power-of-two fork now lives in `plan.model`), and
+    the default path is provably the plan's own bucket table."""
+    from swiftly_tpu.plan import PlanInputs, bucket_sizes, compile_plan
+
+    plan = compile_plan(
+        PlanInputs.from_config("4k[1]-n2k-512", max_batch=8),
+        mode="streamed",
+    )
+    assert plan.serve.bucket_sizes == bucket_sizes(8) == [1, 2, 4, 8]
+    sched = CoalescingScheduler(
+        max_batch=plan.serve.max_batch,
+        bucket_sizes=plan.serve.bucket_sizes,
+    )
+    reqs = [SubgridRequest(SubgridConfig(0, i, 16)) for i in range(5)]
+    configs, n_pad = sched.plan_batch(reqs)
+    assert len(configs) == 8 and n_pad == 3
+    # identical to the default power-of-two padding at every count —
+    # migrating the fork changed nothing
+    default = CoalescingScheduler(max_batch=8)
+    for n in range(1, 9):
+        sub = reqs[:1] * n
+        assert sched.plan_batch(sub)[1] == default.plan_batch(sub)[1]
+
+
+def test_fused_serve_batch_lowers_without_unusable_donations(cover):
+    """ROADMAP item 2's "unusable donation" warnings: PR 2 fixed the
+    `_column_group_finish_j` instance, and a sweep found no survivors
+    in the fused serve batch path — this guard keeps it that way by
+    lowering a fused multi-column batch under warning capture. A
+    reappearing `Some donated buffers were not usable` means a new
+    dangling donation (a silent HBM copy on every dispatch)."""
+    import warnings
+
+    config, _tasks, sgs = cover
+    cols = sorted({sg.off0 for sg in sgs})
+    workload = [sg for sg in sgs if sg.off0 in cols[:2]]
+    svc = SubgridService(
+        _forward(cover), fuse_columns=2,
+        scheduler=CoalescingScheduler(max_batch=16),
+    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        reqs = svc.serve(workload)
+    _assert_all_ok(reqs)
+    donation = [
+        w for w in caught if "donated" in str(w.message).lower()
+    ]
+    assert not donation, [str(w.message) for w in donation]
+
+
 # ---------------------------------------------------------------------------
 # Admission: depth, HBM cost, deadlines
 # ---------------------------------------------------------------------------
