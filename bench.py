@@ -2045,6 +2045,297 @@ def fleet_bench(smoke_mode=False):
     return 0 if not problems else 1
 
 
+def _ensure_mesh_devices(n):
+    """>= 2 devices for the mesh leg: build a virtual CPU mesh when the
+    process has none (`__graft_entry__._ensure_devices`, which refuses
+    to tear down a live TPU/GPU backend — on real multi-chip hardware
+    the existing devices are used as-is)."""
+    import __graft_entry__ as ge
+
+    try:
+        ge._ensure_devices(max(2, int(n)))
+    except RuntimeError:
+        pass  # a real accelerator backend is already up: use it
+    import jax
+
+    return len(jax.devices())
+
+
+def mesh_bench(smoke_mode=False):
+    """`bench.py --mesh [--smoke]`: the mesh-streamed engine leg.
+
+    Runs the SAME spill-cached, facet-partitioned streamed round trip
+    twice — once on the single-chip engine, once on the mesh-streamed
+    engine (`swiftly_tpu.mesh`) with the facet stack sharded over every
+    device — and stamps a ``mesh`` artifact block: the executed layout
+    (shards, padding), the plan's ICI collective bytes, scaling
+    efficiency vs single-chip, the reduction-order match audit
+    (per-facet math is identical; only the forward psum's facet-sum
+    order differs — asserted within BENCH_MESH_TOL, default 5e-5
+    relative, docs/multichip.md), and an HLO audit showing the
+    facet-axis all-reduce in the lowered streamed column pass. The
+    compiled plan's `MeshLayout` is consumed by the engine, so the
+    stamped ``plan_compiled.mesh.status`` is ``"bound"``. Validated by
+    `obs.validate_mesh_artifact`.
+
+    On CPU run under ``XLA_FLAGS=--xla_force_host_platform_device_count
+    =8`` (the leg builds the virtual mesh itself when the backend is
+    not initialised yet); ``BENCH_MESH_DEVICES`` overrides the device
+    count, ``BENCH_MESH_CONFIG`` the config.
+    """
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    n_req = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+    n_av = _ensure_mesh_devices(n_req)  # before any other jax use
+    problems = []
+    if n_av < 2:
+        print(
+            json.dumps(
+                {
+                    "mesh_smoke" if smoke_mode else "mesh": "failed",
+                    "problems": [
+                        f"mesh leg needs >= 2 devices, found {n_av}; on "
+                        "CPU set XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8"
+                    ],
+                }
+            ),
+            flush=True,
+        )
+        return 1
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_mesh_artifact,
+        validate_plan_artifact,
+    )
+
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    out_path = os.environ.get("BENCH_MESH_OUT", "BENCH_mesh.json")
+    metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+    name = os.environ.get(
+        "BENCH_MESH_CONFIG",
+        "1k[1]-n512-256" if smoke_mode else "4k[1]-n2k-512",
+    )
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu import SWIFT_CONFIGS
+    from swiftly_tpu.mesh import (
+        MeshStreamedBackward,
+        MeshStreamedForward,
+        make_facet_mesh,
+    )
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+    from swiftly_tpu.utils.spill import SpillCache
+
+    platform = jax.devices()[0].platform
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    config, fwd, facet_configs, subgrid_configs, _sources = _build(
+        "planar", params, jnp.float32, streamed=True
+    )
+    F = len(facet_configs)
+    half = max(1, F // 2)
+    subsets = [(0, half), (half, F)] if F > 1 else [(0, F)]
+    fold_group = int(os.environ.get("BENCH_FOLD_GROUP", "2"))
+
+    def _passes_counter():
+        return (metrics.export().get("counters") or {}).get(
+            "fwd.passes", 0
+        )
+
+    def roundtrip(fwd_exec, make_bwd):
+        """Spill-cached facet-partitioned round trip: ONE forward pass
+        records the stream, every later facet-subset pass is cache-fed
+        (identical shape to `run_one`'s roundtrip-streamed leg)."""
+        spill = SpillCache(budget_bytes=2e9)
+        parts = []
+        t0 = time.time()
+        for i0, i1 in subsets:
+            bwd = make_bwd(i0, i1)
+            for per_col, group in fwd_exec.stream_column_groups(
+                subgrid_configs, spill=spill
+            ):
+                bwd.add_subgrid_group(
+                    [[sg for _, sg in col] for col in per_col], group
+                )
+            parts.append(np.asarray(bwd.finish()))
+        wall = time.time() - t0
+        return np.concatenate(parts, axis=0), wall, spill
+
+    # -- single-chip reference (the engine every prior PR measured) ------
+    log.info("mesh leg: single-chip reference round trip (%s)", name)
+    passes0 = _passes_counter()
+    ref, wall_single, _spill1 = roundtrip(
+        fwd,
+        lambda i0, i1: StreamedBackward(
+            config, list(facet_configs[i0:i1]), residency="sampled",
+            fold_group=fold_group,
+        ),
+    )
+    single_passes = _passes_counter() - passes0
+
+    # -- mesh-streamed run: the compiled layout, bound by the engine -----
+    n_shards = min(n_av, F)
+    plan = compile_plan(
+        PlanInputs.from_cover(
+            config, facet_configs, subgrid_configs, n_devices=n_shards,
+            real_facets=getattr(fwd, "_facets_real", False),
+            fold_group=fold_group,
+        ),
+        mode="roundtrip-streamed",
+    )
+    mesh = make_facet_mesh(n_devices=plan.mesh.facet_shards)
+    facet_tasks = list(zip(facet_configs, fwd._facet_data))
+    mfwd = MeshStreamedForward(
+        config, facet_tasks, layout=plan.mesh, mesh=mesh
+    )
+    log.info(
+        "mesh leg: mesh-streamed round trip over %d shard(s)",
+        mfwd.facet_shards,
+    )
+    passes0 = _passes_counter()
+    got, wall_mesh, spill2 = roundtrip(
+        mfwd,
+        lambda i0, i1: MeshStreamedBackward(
+            config, list(facet_configs[i0:i1]), mesh=mesh,
+            fold_group=fold_group,
+        ),
+    )
+    mesh_passes = _passes_counter() - passes0
+    if mesh_passes != 1:
+        problems.append(
+            f"mesh round trip ran {mesh_passes} forward pass(es); the "
+            "spill-cached plan must run exactly 1 (later passes "
+            "cache-fed under sharding)"
+        )
+
+    # -- reduction-order match audit -------------------------------------
+    scale = float(np.max(np.abs(ref))) or 1.0
+    max_abs = float(np.max(np.abs(got - ref)))
+    rms = float(np.sqrt(np.mean((got - ref) ** 2)))
+    tol = float(os.environ.get("BENCH_MESH_TOL", "5e-5")) * scale
+    if not max_abs <= tol:
+        problems.append(
+            f"mesh facets diverge from single-chip by {max_abs:.3e} "
+            f"(> reduction-order tolerance {tol:.3e})"
+        )
+
+    # -- HLO audit: the facet-axis collective in the streamed stage ------
+    from swiftly_tpu.parallel.streamed import _column_pass_fwd_sharded
+
+    core = config.core
+    xA = params["xA_size"]
+    F_probe = mfwd.facet_shards
+    colfn = _column_pass_fwd_sharded(core, mesh, xA)
+    probe = (
+        jnp.zeros(
+            (F_probe, core.xM_yN_size, params["yB_size"], 2),
+            dtype=core.dtype,
+        ),
+        jnp.zeros(F_probe, dtype=int),
+        jnp.zeros(F_probe, dtype=int),
+        jnp.zeros((3, 2), dtype=int),
+        jnp.ones((3, xA), dtype=core.dtype),
+        jnp.ones((3, xA), dtype=core.dtype),
+    )
+    hlo = colfn.lower(*probe).compile().as_text()
+    n_all_reduce = len(re.findall(r"all-reduce(?:-start)?\(", hlo))
+    if not n_all_reduce:
+        problems.append(
+            "no all-reduce in the lowered streamed column pass (likely "
+            "HLO text-format drift — see __graft_entry__.dryrun_multichip)"
+        )
+
+    mesh_block = {
+        "n_devices": int(n_av),
+        "facet_shards": int(mfwd.facet_shards),
+        "n_facets": F,
+        "padded_facets": int(mfwd.stack.n_total),
+        "collective_bytes": int(plan.mesh.collective_bytes_total),
+        "single_chip_wall_s": round(wall_single, 4),
+        "mesh_wall_s": round(wall_mesh, 4),
+        # speedup per shard: 1.0 = linear scaling (CPU-simulated meshes
+        # sit far below 1 — the number is the sentinel's trend anchor,
+        # meaningful on real ICI)
+        "scaling_efficiency": round(
+            (wall_single / wall_mesh) / mfwd.facet_shards, 4
+        ),
+        "match": {
+            "max_abs_diff": max_abs,
+            "rms_diff": rms,
+            "tolerance": tol,
+            "within_tolerance": bool(max_abs <= tol),
+            "bit_identical": bool(max_abs == 0.0),
+        },
+        "hlo": {"all_reduce": n_all_reduce, "stage": "fwd.column_pass"},
+        "spill": spill2.stats(),
+        "forward_passes": mesh_passes,
+    }
+    record = {
+        "metric": f"{name} mesh-streamed round-trip wall-clock "
+                  f"({len(subgrid_configs)} subgrids, planar f32, "
+                  f"mesh-streamed, {platform})",
+        "value": round(wall_mesh, 4),
+        "unit": "s",
+        "n_subgrids": len(subgrid_configs),
+        "single_chip_wall_s": round(wall_single, 4),
+        "single_chip_forward_passes": single_passes,
+        "mesh": mesh_block,
+        # the engine bound the layout above, so the stamped status is
+        # "bound" — the acceptance contract validate_mesh_artifact checks
+        "plan_compiled": plan.artifact_block(measured_wall_s=wall_mesh),
+    }
+    record["manifest"] = run_manifest(
+        baseline_source=None,
+        params={"config": name, "mode": "mesh-streamed", **params},
+    )
+    record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+        from swiftly_tpu.obs import trace as otrace
+
+        record["trace"] = summarize_trace(otrace.export())
+        otrace.save(trace_path)
+        otrace.disable()
+    problems.extend(validate_mesh_artifact(record))
+    problems.extend(validate_plan_artifact(record))
+    import json as _json
+
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    metrics.disable()
+    print(
+        json.dumps(
+            {
+                "mesh_smoke" if smoke_mode else "mesh": (
+                    "ok" if not problems else "failed"
+                ),
+                "config": name,
+                "artifact": out_path,
+                "facet_shards": mesh_block["facet_shards"],
+                "scaling_efficiency": mesh_block["scaling_efficiency"],
+                "max_abs_diff": max_abs,
+                "all_reduce": n_all_reduce,
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
+
+
 def smoke():
     """Fast schema-validation leg (`bench.py --smoke`, wired into the
     tier-1 tests): run the 1k round trip with telemetry ON, write the
@@ -2521,6 +2812,8 @@ def main():
         sys.exit(fleet_bench(smoke_mode="--smoke" in sys.argv))
     if "--chaos" in sys.argv:
         sys.exit(chaos(smoke_mode="--smoke" in sys.argv))
+    if "--mesh" in sys.argv:
+        sys.exit(mesh_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         sys.exit(smoke())
 

@@ -19,6 +19,11 @@ artifact) and exits non-zero when a leg regressed:
   threshold below the best (highest) reference — a serve-fleet tail
   latency or capacity regression trips the sentinel exactly like a
   batch-leg wall regression.
+* **scaling efficiency** — for mesh legs (``--mesh`` artifacts): the
+  ``mesh.scaling_efficiency`` metric (speedup per shard vs the
+  single-chip engine, higher is better) more than the threshold below
+  the best same-platform reference — multi-chip scaling that quietly
+  decays is a capacity regression even when the single-chip wall holds.
 
 Legs are matched by (config, mode) — taken from the stamped
 ``manifest.config_params`` when present (every record since PR 1),
@@ -126,7 +131,7 @@ def compare(latest_records, reference_records, threshold=0.2):
         bucket = refs.setdefault(
             (key, leg_platform(rec)),
             {"wall": None, "mfu": None, "p99": None, "rps": None,
-             "n": 0},
+             "se": None, "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -145,6 +150,10 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(rps, (int, float)) and rps > 0:
             if bucket["rps"] is None or rps > bucket["rps"]:
                 bucket["rps"] = rps
+        se = (rec.get("mesh") or {}).get("scaling_efficiency")
+        if isinstance(se, (int, float)) and se > 0:
+            if bucket["se"] is None or se > bucket["se"]:
+                bucket["se"] = se
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -225,6 +234,20 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"throughput {rps:.4g} rps is "
                     f"{100 * (1 - rps / ref['rps']):.1f}% below best "
                     f"reference {ref['rps']:.4g} rps"
+                )
+        # mesh legs: multi-chip scaling sentinel (higher is better)
+        se = (rec.get("mesh") or {}).get("scaling_efficiency")
+        if isinstance(se, (int, float)) and se > 0:
+            verdict["scaling_efficiency"] = se
+            verdict["ref_scaling_efficiency"] = ref["se"]
+            if (
+                ref["se"] is not None
+                and se < ref["se"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"scaling efficiency {se:.4g} is "
+                    f"{100 * (1 - se / ref['se']):.1f}% below best "
+                    f"reference {ref['se']:.4g}"
                 )
         legs.append(verdict)
         if verdict["problems"]:

@@ -1,0 +1,109 @@
+"""Operator entry for the mesh-streamed engine: dryrun + bench legs.
+
+Two drills, both runnable on a laptop (virtual CPU mesh — no TPU
+needed) and on real multi-chip hardware:
+
+* ``--dryrun`` (default): the extended multichip dryrun
+  (`__graft_entry__.dryrun_multichip`) — fused + gspmd + streamed +
+  MESH-STREAMED engines end-to-end on tiny shapes against the analytic
+  oracle, the plan's `MeshLayout` bound by the engine, and the compiled
+  HLO of the streamed column-pass bodies (per-column AND column-group
+  kernels) asserted to carry the facet-axis psum/all-reduce collective.
+* ``--bench``: the `bench.py --mesh [--smoke]` leg — single-chip vs
+  mesh-streamed walls, scaling efficiency, reduction-order match audit,
+  schema-validated ``mesh`` artifact block.
+
+Host-device-count override: ``--devices N`` (default 8) re-runs the
+drill in a CHILD process with ``JAX_PLATFORMS=cpu`` and
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` — the parent's
+backend (possibly a live TPU client) is never torn down, the same
+discipline as ``python __graft_entry__.py``.
+
+Usage:
+    python scripts/mesh_drill.py                      # 8-way dryrun
+    python scripts/mesh_drill.py --devices 4          # 4-way dryrun
+    python scripts/mesh_drill.py --bench --smoke      # mesh bench leg
+    python scripts/mesh_drill.py --bench --config 4k[1]-n2k-512
+
+Exit: 0 on a green drill, the child's non-zero status otherwise.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def child_env(n_devices):
+    """Env for a child process owning an n-device virtual CPU mesh (a
+    real accelerator run would drop these overrides and use the
+    machine's own devices)."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    env.update(JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+    return env
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="mesh-streamed engine drill: dryrun HLO/numerics "
+                    "check or the bench --mesh leg, on a virtual CPU "
+                    "mesh by default"
+    )
+    ap.add_argument(
+        "--devices", type=int, default=8,
+        help="host device count for the virtual mesh (default 8)",
+    )
+    ap.add_argument(
+        "--dryrun", action="store_true",
+        help="run the extended multichip dryrun (the default action)",
+    )
+    ap.add_argument(
+        "--bench", action="store_true",
+        help="run the bench.py --mesh leg instead of the dryrun",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="with --bench: the smoke-scale config",
+    )
+    ap.add_argument(
+        "--config", default=None,
+        help="with --bench: config name (BENCH_MESH_CONFIG)",
+    )
+    args = ap.parse_args(argv)
+
+    if os.environ.get("_MESH_DRILL_CHILD"):
+        # child: the backend was configured by the env; run in-process
+        import __graft_entry__ as ge
+
+        n = int(os.environ["_MESH_DRILL_CHILD"])
+        ge.dryrun_multichip(n)
+        print(f"mesh_drill: dryrun_multichip({n}) OK")
+        return 0
+
+    env = child_env(args.devices)
+    if args.bench:
+        env["BENCH_MESH_DEVICES"] = str(args.devices)
+        if args.config:
+            env["BENCH_MESH_CONFIG"] = args.config
+        cmd = [sys.executable, str(REPO / "bench.py"), "--mesh"]
+        if args.smoke:
+            cmd.append("--smoke")
+        return subprocess.run(cmd, env=env).returncode
+
+    env["_MESH_DRILL_CHILD"] = str(args.devices)
+    return subprocess.run(
+        [sys.executable, str(Path(__file__).resolve())], env=env
+    ).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

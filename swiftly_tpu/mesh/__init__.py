@@ -1,0 +1,59 @@
+"""Mesh-streamed engine: the streamed facet<->subgrid pipeline SPMD over
+a `jax.sharding.Mesh` (ROADMAP item 1).
+
+`MeshStreamedForward` / `MeshStreamedBackward` mirror the
+`parallel.streamed` executor API — column-group streaming, spill feed,
+row slabs, autosave — with the facet stack sharded over the mesh's
+facet axis, per-column facet sums reduced by one `lax.psum` inside the
+jitted stage bodies, and d2h/spill traffic on addressable shards only.
+They bind the plan compiler's `MeshLayout` (``plan.compile_plan(...,
+n_devices=...)`` → ``plan.mesh``), flipping its ``status`` to
+``"bound"``.
+
+Quick start (CPU simulation: 8 virtual devices)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python - <<'EOF'
+    from swiftly_tpu.mesh import MeshStreamedForward, MeshStreamedBackward
+    fwd = MeshStreamedForward(config, facet_tasks, layout=plan.mesh)
+    bwd = MeshStreamedBackward(config, facet_configs, mesh=fwd.mesh)
+    for per_col, group in fwd.stream_column_groups(subgrid_configs):
+        bwd.add_subgrid_group([[sg for _, sg in c] for c in per_col], group)
+    facets = bwd.finish()
+    EOF
+
+See docs/multichip.md for the layout/env knobs, the CPU host-device
+simulation recipe, and the reduction-order tolerance contract; the
+`bench.py --mesh` leg measures scaling vs the single-chip engine.
+"""
+
+from ..parallel.mesh import (
+    FACET_AXIS,
+    facet_sharding,
+    initialize_multihost,
+    make_facet_mesh,
+    mesh_size,
+    pad_to_shards,
+)
+from .engine import (
+    MeshStreamedBackward,
+    MeshStreamedForward,
+    attach_mesh,
+    host_gather,
+    host_replica,
+    resolve_facet_shards,
+)
+
+__all__ = [
+    "FACET_AXIS",
+    "MeshStreamedBackward",
+    "MeshStreamedForward",
+    "attach_mesh",
+    "facet_sharding",
+    "host_gather",
+    "host_replica",
+    "initialize_multihost",
+    "make_facet_mesh",
+    "mesh_size",
+    "pad_to_shards",
+    "resolve_facet_shards",
+]

@@ -1,0 +1,281 @@
+"""Mesh-streamed execution engine: the streamed pipeline SPMD over a
+`jax.sharding.Mesh` (ROADMAP item 1 — the multi-chip arc).
+
+`parallel.streamed` carries a facet-sharded shard_map variant of every
+stage body (`*_sharded`: facet pass, column pass, sampled/ct/fft folds,
+finishes), but until now only the isolated kernels in `parallel.sharded`
+and the whole-cover batched paths consumed a mesh — the 64k/128k
+streamed engines ran on one chip. This module is the binding layer that
+turns those pieces into a mesh-streamed ENGINE:
+
+* `MeshStreamedForward` / `MeshStreamedBackward` mirror the
+  `StreamedForward` / `StreamedBackward` API exactly
+  (`stream_column_groups`, spill feed, `add_subgrid_group`, row slabs,
+  autosave) — they ARE the streamed executors, constructed over a config
+  whose facet stacks are laid out via `parallel.mesh.facet_sharding`.
+  Per-column partial sums reduce with ONE `lax.psum` over the facet axis
+  inside the jitted column-pass body (the streamed pipeline's only
+  collective; every facet-side op — sampled facet pass, backward column
+  pass, folds, finishes — is shard-local). The facet stack is
+  zero-padded to a multiple of the mesh size (`pad_to_shards`; padded
+  facets carry zero masks and contribute exact zeros).
+* The engine binds the plan compiler's `MeshLayout`
+  (`plan.compiler.MeshLayout`, a ``status: "stub"`` field since PR 7):
+  pass ``layout=plan.mesh`` and the engine validates the shard count,
+  records the executed padding, and flips ``status`` to ``"bound"`` —
+  the artifact then shows which executor consumed the layout.
+* d2h/spill traffic reads only ADDRESSABLE shards (`host_replica` /
+  `host_gather`): on a multi-host pod slice each process pulls its own
+  shards (or any one replica of a replicated output) instead of
+  addressing devices it cannot reach.
+
+Exactness contract: per-facet math is byte-identical to the single-chip
+engine (the shard_map bodies are built from the same ``*_fn`` builders);
+only the forward column pass's facet-sum REDUCTION ORDER differs (local
+scan per shard + psum vs one scan over all facets), so mesh and
+single-chip results agree to reduction-order tolerance, which
+``bench.py --mesh`` asserts and stamps (docs/multichip.md).
+
+The pattern is exactly the contraction-over-mesh shape of "Large-Scale
+Discrete Fourier Transform on TPUs" (arXiv 2002.03260) and "Distributed
+Linear Algebra with TPUs" (arXiv 2112.09017): shard the summed axis,
+reduce locally, one ICI collective per contraction.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..parallel.mesh import (
+    FACET_AXIS,
+    facet_sharding,
+    make_facet_mesh,
+    mesh_size,
+    pad_to_shards,
+)
+from ..parallel.streamed import StreamedBackward, StreamedForward
+from ..resilience.faults import fault_point as _fault_point
+from ..resilience.retry import retry_transient as _retry
+
+__all__ = [
+    "MeshStreamedBackward",
+    "MeshStreamedForward",
+    "attach_mesh",
+    "host_gather",
+    "host_replica",
+    "resolve_facet_shards",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_facet_shards(n_facets, n_devices=None):
+    """Facet-shard count for a cover: every available device, capped at
+    the facet count (a shard with no real facet would hold only
+    zero-padding — exact, but pure waste)."""
+    import jax
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    return max(1, min(int(n_devices), int(n_facets)))
+
+
+def attach_mesh(swiftly_config, mesh):
+    """A shallow copy of ``swiftly_config`` with ``mesh`` attached.
+
+    The copy shares the numerical core (no PSWF rebuild); only the
+    execution-fabric field differs — the caller's config object is
+    never mutated."""
+    if swiftly_config.core.backend in ("numpy", "native"):
+        raise ValueError(
+            "a device mesh requires the 'jax' or 'planar' backend"
+        )
+    cfg = copy.copy(swiftly_config)
+    cfg.mesh = mesh
+    return cfg
+
+
+def host_replica(arr):
+    """One host copy of a REPLICATED mesh array, reading only
+    addressable shards.
+
+    Single-process (all shards addressable): a plain ``np.asarray``.
+    Multi-host: every device holds the full replicated value, so the
+    first ADDRESSABLE shard's data is the whole array — no cross-host
+    pull ever happens."""
+    if not hasattr(arr, "addressable_shards"):
+        return np.asarray(arr)
+    import jax
+
+    if jax.process_count() == 1:
+        return np.asarray(arr)
+    return np.asarray(arr.addressable_shards[0].data)
+
+
+def host_gather(arr):
+    """Host copy of a (possibly facet-SHARDED) mesh array from its
+    addressable shards only.
+
+    Single-process: ``np.asarray``. Multi-host: each process fills the
+    global-shaped output at its addressable shards' indices and leaves
+    the rows it cannot address ZERO — the per-process view of a sharded
+    result (processes own disjoint facet rows; a global gather would be
+    a cross-host transfer the engine deliberately never performs —
+    docs/multichip.md)."""
+    if not hasattr(arr, "addressable_shards"):
+        return np.asarray(arr)
+    import jax
+
+    if jax.process_count() == 1 or getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    out = np.zeros(arr.shape, dtype=arr.dtype)
+    for shard in arr.addressable_shards:
+        out[shard.index] = np.asarray(shard.data)
+    return out
+
+
+def _resolve_mesh(swiftly_config, n_facets, layout, mesh, n_devices):
+    """(mesh, layout) for an engine: an explicit mesh wins, else the
+    layout's shard count, else the config's own mesh, else every device
+    (capped at the facet count). A layout, when given, must agree with
+    the mesh it is bound to."""
+    if mesh is None:
+        mesh = getattr(swiftly_config, "mesh", None)
+    if mesh is None:
+        shards = (
+            int(layout.facet_shards)
+            if layout is not None
+            else resolve_facet_shards(n_facets, n_devices)
+        )
+        mesh = make_facet_mesh(n_devices=shards)
+    if layout is not None and int(layout.facet_shards) != mesh_size(mesh):
+        raise ValueError(
+            f"MeshLayout plans {layout.facet_shards} facet shard(s) but "
+            f"the mesh has {mesh_size(mesh)} device(s); compile the plan "
+            f"with n_devices={mesh_size(mesh)} or build the matching mesh"
+        )
+    return mesh, layout
+
+
+def _bind_layout(layout, engine):
+    """Flip the plan's MeshLayout stub to ``bound`` and record what the
+    engine actually executed (the padding is the stack's, not a
+    re-derivation)."""
+    if layout is None:
+        return None
+    layout.padded_facets = int(engine.stack.n_total)
+    layout.status = "bound"
+    if _metrics.enabled():
+        _metrics.gauge("mesh.layout", dict(layout.as_dict()))
+    return layout
+
+
+class MeshStreamedForward(StreamedForward):
+    """`StreamedForward` over a facet-sharded device mesh.
+
+    Same API and the same sampled-DFT streaming strategy (facets
+    resident, column groups, spill feed); the facet stack, offsets and
+    masks are placed with `parallel.mesh.facet_sharding`, each device's
+    column pass reduces its LOCAL facets and one psum per column group
+    completes the sum over the mesh.
+
+    :param layout: optional `plan.compiler.MeshLayout` (e.g.
+        ``compile_plan(...).mesh``) — validated against the mesh and
+        flipped to ``status: "bound"``
+    :param mesh: explicit `jax.sharding.Mesh` (shared with the backward
+        so device-to-device feeding stays on one fabric); default: the
+        config's mesh, else a fresh 1-D facet mesh over ``n_devices``
+    :param n_devices: device count when no layout/mesh is given
+        (default: all available, capped at the facet count)
+    """
+
+    def __init__(self, swiftly_config, facet_tasks, layout=None, mesh=None,
+                 n_devices=None, col_block=512, col_group=None):
+        mesh, layout = _resolve_mesh(
+            swiftly_config, len(facet_tasks), layout, mesh, n_devices
+        )
+        super().__init__(
+            attach_mesh(swiftly_config, mesh), facet_tasks,
+            col_block=col_block, residency="device", col_group=col_group,
+        )
+        self.mesh = mesh
+        self.layout = _bind_layout(layout, self)
+
+    @property
+    def facet_shards(self):
+        return mesh_size(self.mesh)
+
+    def layout_summary(self):
+        """The executed mesh layout as a dict (artifact-ready)."""
+        return {
+            "n_devices": self.facet_shards,
+            "facet_shards": self.facet_shards,
+            "axis": FACET_AXIS,
+            "n_facets": int(self.stack.n_real),
+            "padded_facets": int(self.stack.n_total),
+        }
+
+    def _spill_store(self, spill, per_col, out_g):
+        """Copy one yielded group's stack to the cache — reading only
+        an addressable replica of the (replicated) group output, so the
+        spill fill never addresses another host's devices."""
+        if spill.gave_up:
+            return
+
+        def pull():
+            _fault_point("transfer.d2h")
+            with _metrics.stage("spill.write") as st:
+                arr = host_replica(out_g)
+                st.bytes_moved = int(arr.nbytes)
+            return arr
+
+        host = _retry(pull, site="transfer.d2h")
+        if spill.put(per_col, host) and _metrics.enabled():
+            _metrics.count("spill.writes")
+            _metrics.count("spill.bytes_written", int(host.nbytes))
+
+
+class MeshStreamedBackward(StreamedBackward):
+    """`StreamedBackward` over a facet-sharded device mesh.
+
+    Same API (per-column/stack/group feeding, fold groups, ``row_slab``
+    output-row slabs, autosave/resume); the image-space accumulator,
+    pending rows and masks are facet-sharded, every fold is shard-local
+    (no collectives — the subgrids arrive replicated), and checkpoints
+    record the mesh layout so kill+resume restores onto the same
+    sharding (`utils.checkpoint`).
+
+    Pass the forward's ``mesh`` so a device-to-device feed
+    (`MeshStreamedForward.stream_column_groups` →
+    `add_subgrid_group`) stays on one fabric.
+    """
+
+    def __init__(self, swiftly_config, facet_configs, layout=None,
+                 mesh=None, n_devices=None, col_block=512,
+                 residency="sampled", fold_group=4, row_slab=None):
+        mesh, layout = _resolve_mesh(
+            swiftly_config, len(facet_configs), layout, mesh, n_devices
+        )
+        super().__init__(
+            attach_mesh(swiftly_config, mesh), facet_configs,
+            col_block=col_block, residency=residency,
+            fold_group=fold_group, row_slab=row_slab,
+        )
+        self.mesh = mesh
+        self.layout = _bind_layout(layout, self)
+
+    @property
+    def facet_shards(self):
+        return mesh_size(self.mesh)
+
+    def finish(self):
+        """Finished facet stack as a host array, pulled from addressable
+        shards only (each pod-slice process receives its own facet rows;
+        single-process receives everything)."""
+        if self._base.residency == "sampled":
+            return host_gather(self.finish_device())[: self.stack.n_real]
+        return super().finish()

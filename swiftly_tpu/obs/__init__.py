@@ -42,6 +42,7 @@ from .manifest import (
     run_manifest,
     validate_artifact,
     validate_fleet_artifact,
+    validate_mesh_artifact,
     validate_plan_artifact,
     validate_resilience_artifact,
     validate_serve_artifact,
@@ -58,6 +59,7 @@ __all__ = [
     "trace",
     "validate_artifact",
     "validate_fleet_artifact",
+    "validate_mesh_artifact",
     "validate_plan_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",

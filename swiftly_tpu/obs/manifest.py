@@ -29,6 +29,7 @@ __all__ = [
     "BASELINE_SOURCES",
     "FLEET_ARTIFACT_FIELDS",
     "MANIFEST_SCHEMA",
+    "MESH_ARTIFACT_FIELDS",
     "PLAN_ARTIFACT_FIELDS",
     "RESILIENCE_ARTIFACT_FIELDS",
     "SERVE_ARTIFACT_FIELDS",
@@ -36,6 +37,7 @@ __all__ = [
     "run_manifest",
     "validate_artifact",
     "validate_fleet_artifact",
+    "validate_mesh_artifact",
     "validate_plan_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
@@ -489,6 +491,132 @@ def validate_plan_artifact(record):
             f"plan coeffs_source {block.get('coeffs_source')!r} not "
             "default|measured"
         )
+    mesh = block.get("mesh")
+    if isinstance(mesh, dict):
+        if mesh.get("status") not in _PLAN_MESH_STATUSES:
+            problems.append(
+                f"plan mesh status {mesh.get('status')!r} not in "
+                f"{_PLAN_MESH_STATUSES}"
+            )
+        shards = mesh.get("facet_shards")
+        if isinstance(shards, int) and shards < 1:
+            problems.append(f"plan mesh facet_shards {shards} < 1")
+    elif "mesh" in block:
+        problems.append("plan mesh block is not a dict")
+    return problems
+
+
+# "stub": the compiler planned a layout no executor consumed (incl. the
+# trivial single-device layout); "bound": the mesh-streamed engine
+# executed it (swiftly_tpu.mesh flips the status at construction).
+_PLAN_MESH_STATUSES = ("stub", "bound")
+
+
+# The mesh block every `bench.py --mesh` artifact must carry — the
+# mesh-streamed drill's schema contract: the layout that ran (shards,
+# padding), the cross-device traffic, scaling vs the single-chip
+# engine, and the reduction-order match audit.
+MESH_ARTIFACT_FIELDS = (
+    "n_devices",
+    "facet_shards",
+    "padded_facets",
+    "collective_bytes",
+    "single_chip_wall_s",
+    "mesh_wall_s",
+    "scaling_efficiency",
+    "match",
+    "hlo",
+)
+
+
+def validate_mesh_artifact(record):
+    """Problems with a mesh-mode BENCH artifact, as a list of strings.
+
+    Mesh legs carry no numpy baseline (the single-chip streamed engine
+    is the reference, recorded in the block itself) but must carry the
+    full manifest plus a coherent ``mesh`` block: a real multi-shard
+    layout (>= 2 facet shards — a one-shard "mesh" proves nothing), the
+    padded facet count a multiple of the shard count, non-negative
+    collective bytes, a positive scaling_efficiency, a match audit
+    whose max |diff| sits inside the stamped reduction-order tolerance,
+    an HLO audit showing >= 1 facet-axis all-reduce in the lowered
+    streamed stage, and ``plan_compiled.mesh.status == "bound"`` — a
+    mesh drill whose plan nothing consumed, or whose results drifted
+    past tolerance, is a correctness bug, not a scaling result.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    mesh = record.get("mesh")
+    if not isinstance(mesh, dict):
+        problems.append("missing mesh block")
+        return problems
+    for field in MESH_ARTIFACT_FIELDS:
+        if field not in mesh:
+            problems.append(f"mesh block missing {field!r}")
+    shards = mesh.get("facet_shards")
+    if isinstance(shards, int) and shards < 2:
+        problems.append(
+            f"facet_shards {shards} < 2 (a one-shard mesh leg "
+            "exercises no collective)"
+        )
+    padded = mesh.get("padded_facets")
+    if (
+        isinstance(shards, int) and shards >= 1
+        and isinstance(padded, int) and padded % shards
+    ):
+        problems.append(
+            f"padded_facets {padded} is not a multiple of "
+            f"facet_shards {shards}"
+        )
+    cb = mesh.get("collective_bytes")
+    if cb is not None and (not isinstance(cb, (int, float)) or cb < 0):
+        problems.append(f"collective_bytes {cb!r} is not a byte count")
+    se = mesh.get("scaling_efficiency")
+    if se is not None and (not isinstance(se, (int, float)) or se <= 0):
+        problems.append(
+            f"scaling_efficiency {se!r} is not a positive number"
+        )
+    match = mesh.get("match")
+    if not isinstance(match, dict) or not (
+        {"max_abs_diff", "tolerance", "within_tolerance"} <= set(match)
+    ):
+        problems.append(
+            "missing match {max_abs_diff, tolerance, within_tolerance} "
+            "block"
+        )
+    else:
+        if match.get("within_tolerance") is not True:
+            problems.append(
+                f"mesh result outside the reduction-order tolerance: "
+                f"{match}"
+            )
+        mad, tol = match.get("max_abs_diff"), match.get("tolerance")
+        if (
+            isinstance(mad, (int, float))
+            and isinstance(tol, (int, float))
+            and mad > tol
+        ):
+            problems.append(
+                f"match max_abs_diff {mad} > tolerance {tol} but "
+                "within_tolerance claims otherwise"
+            )
+    hlo = mesh.get("hlo")
+    if isinstance(hlo, dict):
+        if not hlo.get("all_reduce"):
+            problems.append(
+                "lowered streamed stage shows no facet-axis all-reduce"
+            )
+    elif "hlo" in mesh:
+        problems.append("mesh hlo block is not a dict")
+    pc = record.get("plan_compiled")
+    if isinstance(pc, dict):
+        status = (pc.get("mesh") or {}).get("status")
+        if status != "bound":
+            problems.append(
+                f"plan_compiled.mesh.status {status!r} != 'bound' — "
+                "the engine must consume the compiled layout"
+            )
+    else:
+        problems.append("mesh artifact missing plan_compiled block")
     return problems
 
 

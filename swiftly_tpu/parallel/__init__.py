@@ -1,14 +1,56 @@
 """Parallel execution: batched kernels, device meshes, sharded pipelines,
-out-of-core streamed executors."""
+out-of-core streamed executors.
 
-from . import batched, sharded, streamed
+This package namespace is the supported import surface for the mesh and
+sharded API — ``from swiftly_tpu.parallel import make_facet_mesh,
+FACET_AXIS`` (or the executor-level `swiftly_tpu.mesh` engine). Deep
+module imports (``swiftly_tpu.parallel.mesh.make_facet_mesh``) still
+work but are deprecated as an import style: every public name is
+re-exported here so call sites stop depending on the internal module
+split.
+"""
+
+from . import batched, mesh, sharded, streamed
+from .mesh import (
+    FACET_AXIS,
+    facet_sharding,
+    initialize_multihost,
+    make_facet_mesh,
+    mesh_size,
+    pad_to_shards,
+    place_facet_sharded,
+    replicated_sharding,
+)
+from .sharded import (
+    backward_all_sharded,
+    forward_all_sharded,
+    split_accumulate_sharded,
+    split_subgrid_sharded,
+    subgrid_from_columns_sharded,
+    subgrids_from_columns_sharded,
+)
 from .streamed import CachedColumnFeed, StreamedBackward, StreamedForward
 
 __all__ = [
     "CachedColumnFeed",
+    "FACET_AXIS",
     "StreamedBackward",
     "StreamedForward",
+    "backward_all_sharded",
     "batched",
+    "facet_sharding",
+    "forward_all_sharded",
+    "initialize_multihost",
+    "make_facet_mesh",
+    "mesh",
+    "mesh_size",
+    "pad_to_shards",
+    "place_facet_sharded",
+    "replicated_sharding",
     "sharded",
+    "split_accumulate_sharded",
+    "split_subgrid_sharded",
     "streamed",
+    "subgrid_from_columns_sharded",
+    "subgrids_from_columns_sharded",
 ]

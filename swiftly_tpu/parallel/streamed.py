@@ -2684,6 +2684,7 @@ class StreamedForward:
             ),
         }
         if base.mesh is not None:
+            self.last_plan["mesh_shards"] = _mesh_size(base.mesh)
             samfn = _facet_pass_sampled_sharded(
                 core, base.mesh, self._facets_real
             )
@@ -3733,9 +3734,27 @@ class StreamedBackward:
         if base.mesh is not None:
             # per-column sharded path (the group-batched column pass is
             # single-device; on a mesh the latency it amortises is not
-            # the bottleneck anyway)
-            for gi, col in enumerate(col_sg_lists):
-                self.add_subgrid_stack(col, subgrids_group[gi][: len(col)])
+            # the bottleneck anyway) — but fold batching and the
+            # autosave tick still follow the GROUP contract: pending
+            # folds flush at both group boundaries and the autosave
+            # fires once per group, so a kill+resume refeeds whole
+            # groups with fold batching identical before and after
+            # (the same bit-identity contract as the single-device
+            # group path below; per-column ticks would let a snapshot
+            # land mid-group and straddle fold concatenations).
+            self._flush_folds()
+            autosave, self._autosave = self._autosave, None
+            n_group = 0
+            try:
+                for gi, col in enumerate(col_sg_lists):
+                    self.add_subgrid_stack(
+                        col, subgrids_group[gi][: len(col)]
+                    )
+                    n_group += len(col)
+            finally:
+                self._autosave = autosave
+            self._flush_folds()
+            self._autosave_tick(n_group)
             return
         core = base.core
         yB = base.stack.size

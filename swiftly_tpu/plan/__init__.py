@@ -11,7 +11,9 @@ Three parts (ROADMAP item 4):
 * ``plan.compiler`` — `compile_plan()` searches the model and emits one
   executable `Plan`: the backward facet x row-slab pass grid, the spill
   policy (RAM/disk/replay), serve bucket shapes + admission pricing,
-  and a mesh-layout stub for the multi-chip arc. bench.py, the
+  and the mesh layout (`plan_mesh_layout`: facet shards from device
+  count + HBM budget, ICI collective bytes priced; bound by the
+  mesh-streamed engine in `swiftly_tpu.mesh`). bench.py, the
   coalescing scheduler, the spill cache and the serve fleet are its
   consumers; seed-geometry plans are pinned equivalent to the old
   heuristics by tests/test_128k.py.
@@ -35,6 +37,7 @@ from .compiler import (
     SpillPolicy,
     compile_plan,
     plan_backward_passes,
+    plan_mesh_layout,
 )
 from .model import (
     CostCoefficients,
@@ -63,6 +66,7 @@ __all__ = [
     "load_history",
     "model",
     "plan_backward_passes",
+    "plan_mesh_layout",
     "projected_column_bytes",
     "projected_request_bytes",
 ]

@@ -16,10 +16,11 @@ priced independently (ROADMAP item 4):
   explainable without a device but never forks the transient
   accounting).
 
-Plus a `MeshLayout` stub for the coming multi-chip arc: the mesh shape
-must fall out of the same model (arXiv 2002.03260), so the field exists
-now and records the single-device layout until the sharded engine
-consumes it.
+Plus the `MeshLayout`: the mesh shape falls out of the same model
+(arXiv 2002.03260) — `plan_mesh_layout` shards the facet axis over the
+planned device count, prices per-shard HBM and ICI collective bytes,
+and the mesh-streamed engine (`swiftly_tpu.mesh`) binds the layout at
+construction (``status: "stub"`` → ``"bound"``).
 
 Selection policy: with DEFAULT coefficients the compiler keeps the seed
 heuristics' choices (provable equivalence); with MEASURED coefficients
@@ -52,6 +53,7 @@ __all__ = [
     "SpillPolicy",
     "compile_plan",
     "plan_backward_passes",
+    "plan_mesh_layout",
 ]
 
 PLAN_SCHEMA = "swiftly-tpu-plan/1"
@@ -217,19 +219,39 @@ class ServePlan:
 
 @dataclass
 class MeshLayout:
-    """Mesh-layout stub for the multi-chip arc (ROADMAP item 1).
+    """How the plan shards the streamed pipeline over a device mesh
+    (ROADMAP item 1).
 
-    The facet axis is the natural shard (every accumulation is a sum
-    over facets; arXiv 2002.03260) — the layout records how the plan
-    WOULD shard today, so the sharded engine becomes a consumer of this
-    field instead of growing its own heuristic. Until then
-    ``status: "stub"`` says no executor binds to it yet.
+    The facet axis is the natural shard — every accumulation is a sum
+    over facets, the contraction-over-mesh shape of arXiv 2002.03260 —
+    so the layout is 1-D: ``facet_shards`` devices, the facet stack
+    zero-padded to ``padded_facets`` (`parallel.mesh.pad_to_shards`;
+    padded facets carry zero masks and contribute exact zeros). The
+    cost model prices per-shard HBM (``per_shard_stack_bytes`` vs the
+    budget → ``fits_hbm``) and the ICI collective traffic (one psum of
+    the column's [S, xM, xM] partials per column —
+    `utils.profiling.column_collective_bytes`).
+
+    ``status`` records pedigree: ``"stub"`` until an executor consumes
+    the layout; the mesh-streamed engine
+    (`swiftly_tpu.mesh.MeshStreamedForward` / ``...Backward``) flips it
+    to ``"bound"`` and records the padding it actually executed.
     """
 
     n_devices: int = 1
     facet_shards: int = 1
     axis: str = "facets"
     status: str = "stub"
+    padded_facets: int = 0
+    per_shard_stack_bytes: int = 0
+    fits_hbm: bool | None = None
+    collective_bytes_per_column: int = 0
+    collective_bytes_total: int = 0
+
+    def bind(self):
+        """Mark the layout consumed by an executor."""
+        self.status = "bound"
+        return self
 
     def as_dict(self):
         return {
@@ -237,7 +259,59 @@ class MeshLayout:
             "facet_shards": self.facet_shards,
             "axis": self.axis,
             "status": self.status,
+            "padded_facets": self.padded_facets,
+            "per_shard_stack_bytes": int(self.per_shard_stack_bytes),
+            "fits_hbm": self.fits_hbm,
+            "collective_bytes_per_column": int(
+                self.collective_bytes_per_column
+            ),
+            "collective_bytes_total": int(self.collective_bytes_total),
         }
+
+
+def plan_mesh_layout(inputs, mode="roundtrip-streamed"):
+    """The mesh layout the cost model chooses for ``inputs``.
+
+    Shard count: every planned device, capped at the facet count (a
+    shard holding only zero-padding is exact but pure waste). The HBM
+    budget enters as the per-shard residency check: the sharded facet
+    stack slice plus a one-column working set must fit the per-device
+    budget (``fits_hbm``; None with no budget, e.g. CPU). Collective
+    bytes are the forward column psum (ring all-reduce accounting) plus
+    — for round-trip modes — the backward's replicated-subgrid
+    placement traffic, totalled over the cover.
+    """
+    from ..parallel.mesh import pad_to_shards
+    from ..utils.profiling import column_collective_bytes
+
+    shards = max(1, min(int(inputs.n_devices), int(inputs.n_facets)))
+    padded = pad_to_shards(inputs.n_facets, shards)
+    per_facet = inputs.yB * inputs.yB * (
+        inputs.dtype_bytes if inputs.real_facets else inputs.per_el
+    )
+    per_shard = (padded // shards) * per_facet
+    fits = None
+    if inputs.hbm_budget:
+        fits = bool(per_shard + 3e9 <= inputs.hbm_budget)
+    core = inputs.base().core
+    col_fwd = column_collective_bytes(
+        core, shards, inputs.subgrids_per_column, "forward"
+    )
+    total = col_fwd * inputs.n_columns
+    if mode == "roundtrip-streamed":
+        total += inputs.n_columns * column_collective_bytes(
+            core, shards, inputs.subgrids_per_column, "backward",
+            subgrid_size=inputs.xA,
+        )
+    return MeshLayout(
+        n_devices=int(inputs.n_devices),
+        facet_shards=shards,
+        padded_facets=int(padded),
+        per_shard_stack_bytes=int(per_shard),
+        fits_hbm=fits,
+        collective_bytes_per_column=int(col_fwd),
+        collective_bytes_total=int(total),
+    )
 
 
 @dataclass
@@ -309,7 +383,24 @@ class Plan:
             f"  serve: buckets {self.serve.bucket_sizes} "
             f"(request {self.serve.request_bytes} B, "
             f"column {self.serve.column_bytes / 1e6:.1f} MB)",
-            f"  mesh: {self.mesh.as_dict()}",
+            f"  mesh: {self.mesh.facet_shards} facet shard(s) over "
+            f"{self.mesh.n_devices} device(s) [{self.mesh.status}]"
+            + (
+                f" — {i.n_facets} facets padded to "
+                f"{self.mesh.padded_facets}, "
+                f"{self.mesh.per_shard_stack_bytes / gib:.2f} GiB "
+                f"stack/shard"
+                + (
+                    ""
+                    if self.mesh.fits_hbm is None
+                    else (" (fits HBM)" if self.mesh.fits_hbm
+                          else " (EXCEEDS HBM)")
+                )
+                + f", {self.mesh.collective_bytes_total / 1e9:.2f} GB "
+                f"ICI collectives/cover"
+                if self.mesh.facet_shards > 1
+                else ""
+            ),
             f"  predicted wall: {self.predicted['wall_s']:.1f} s "
             f"({self.coeffs_source} coefficients), HBM peak "
             f"{self.predicted['hbm_peak_bytes'] / gib:.2f} GiB",
@@ -337,14 +428,27 @@ class Plan:
 
 
 def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
-             fwd_min, reserve):
-    """Predicted per-stage walls + totals for one candidate plan."""
+             fwd_min, reserve, mesh=None):
+    """Predicted per-stage walls + totals for one candidate plan.
+
+    With a multi-shard ``mesh`` the prediction prices PER-SHARD HBM
+    (facet stack, backward accumulator and row pipeline all shard over
+    the facet axis) and adds the ICI collective stage (`mesh.psum`,
+    priced by bytes — the layout's ring all-reduce total).
+    """
+    shards = mesh.facet_shards if mesh is not None else 1
     stages = []
     if mode in ("streamed", "roundtrip-streamed"):
         stages += price_forward(inputs, coeffs)
     if mode == "roundtrip-streamed":
         stages += price_backward(
             inputs, parts, fold_group, coeffs, spill_fed=use_spill
+        )
+    if mesh is not None and shards > 1 and mesh.collective_bytes_total:
+        stages.append(
+            coeffs.price(
+                "mesh.psum", bytes_moved=mesh.collective_bytes_total
+            )
         )
     wall = sum(s.wall_s for s in stages)
     resident = max(
@@ -356,9 +460,9 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
         for i0, i1, r0, r1 in parts
     ) if mode == "roundtrip-streamed" else 0
     if mode == "roundtrip-streamed":
-        peak = resident + fwd_min + reserve
+        peak = resident / shards + fwd_min + reserve
     else:
-        peak = inputs.facet_stack_bytes + 3e9
+        peak = inputs.facet_stack_bytes / shards + 3e9
     if inputs.hbm_budget:
         peak = min(peak, inputs.hbm_budget)
     return {
@@ -467,6 +571,11 @@ def compile_plan(
             return "disk"
         return "replay"
 
+    # the mesh layout falls out of the same model (arXiv 2002.03260):
+    # chosen before the candidate search so every prediction prices the
+    # per-shard HBM and the ICI collective bytes of the SAME layout
+    mesh = plan_mesh_layout(inputs, mode=mode)
+
     # -- fold-group search (the measured-feedback lever) ---------------------
     candidates = sorted(
         {inputs.fold_group}
@@ -481,7 +590,7 @@ def compile_plan(
         parts_c, resident_c = _passes(fg)
         use_spill_c = _spill_mode(parts_c) in ("ram", "disk")
         pred_c = _predict(inputs, parts_c, fg, coeffs, mode,
-                          use_spill_c, fwd_min, reserve)
+                          use_spill_c, fwd_min, reserve, mesh=mesh)
         alt = {
             "fold_group": fg,
             "n_passes": len(parts_c),
@@ -504,6 +613,7 @@ def compile_plan(
         predicted = _predict(
             inputs, parts, fold_group, coeffs, mode,
             _spill_mode(parts) in ("ram", "disk"), fwd_min, reserve,
+            mesh=mesh,
         )
         chosen_alt = next(
             a for a in alternatives if a["fold_group"] == fold_group
@@ -526,11 +636,6 @@ def compile_plan(
         request_bytes=inputs.xA * inputs.xA * inputs.per_el,
         column_bytes=inputs.n_facets * inputs.m * inputs.yN
         * inputs.per_el,
-    )
-
-    mesh = MeshLayout(
-        n_devices=inputs.n_devices,
-        facet_shards=min(inputs.n_devices, inputs.n_facets),
     )
 
     return Plan(

@@ -361,6 +361,10 @@ _DEFAULT_BYTES_PER_S = {
     "spill.h2d": 6e9,
     "spill.write": 3e9,
     "spill.read": 6e9,
+    # per-link ICI ring bandwidth anchor (v5e ~45 GB/s effective);
+    # coarse like every default — it ranks mesh plans, it is not a
+    # contract (measured coefficients refit it like any other stage)
+    "mesh.psum": 45e9,
 }
 _DEFAULT_DISPATCH_S = 0.1
 

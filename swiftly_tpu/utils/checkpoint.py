@@ -368,6 +368,9 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
     if processed_subgrids is None:
         processed_subgrids = getattr(backward, "processed", None)
     arrays = {}
+    from ..parallel.mesh import FACET_AXIS, mesh_size
+
+    mesh = backward._base.mesh
     meta = {
         "version": _VERSION,
         "kind": "streamed_backward",
@@ -379,6 +382,18 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
         "yB_pad": backward._base._yB_pad,
         "naf_keys": [],
         "processed": list(map(list, processed_subgrids or [])),
+        # the mesh layout the accumulators were sharded with: resume
+        # must restore onto the SAME sharding (facet padding and shard
+        # ownership both depend on it) — None for single-device sessions
+        "mesh": (
+            {
+                "n_devices": int(mesh_size(mesh)),
+                "facet_shards": int(mesh_size(mesh)),
+                "axis": FACET_AXIS,
+            }
+            if mesh is not None
+            else None
+        ),
     }
     if backward._base.residency == "sampled":
         # the whole state is the image-space accumulator (pending rows
@@ -413,6 +428,23 @@ def _restore_streamed_one(path, backward):
     data, meta = _open_verified(path)
     with data:
         core = backward.core
+        if "mesh" in meta:
+            # pre-mesh snapshots lack the key entirely (skip the check);
+            # new snapshots always record it, None meaning single-device.
+            # Checked BEFORE the generic stack-size check: a layout
+            # mismatch also changes the facet padding, and the operator
+            # should be told the cause, not the symptom.
+            from ..parallel.mesh import mesh_size
+
+            saved_mesh = (meta["mesh"] or {}).get("n_devices", 1)
+            have_mesh = mesh_size(backward._base.mesh)
+            if saved_mesh != have_mesh:
+                raise ValueError(
+                    f"Checkpoint was written on a {saved_mesh}-device "
+                    f"mesh layout; this session has {have_mesh} — "
+                    "restore onto the same sharding (facet padding and "
+                    "shard ownership depend on it)"
+                )
         _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
         saved_res = meta.get("residency")
         is_sampled = backward._base.residency == "sampled"

@@ -315,6 +315,80 @@ def test_bench_fleet_smoke_leg(tmp_path):
     ) == 1
 
 
+def test_bench_mesh_smoke_leg(tmp_path):
+    """The `bench.py --mesh --smoke` leg (ISSUE-8 acceptance), run
+    exactly as the driver would — fresh subprocess, CPU with 8 virtual
+    devices via XLA_FLAGS: the mesh-streamed engine's spill-cached
+    round trip over 8 facet shards matches the single-chip streamed
+    engine within the stamped reduction-order tolerance, exactly ONE
+    forward pass runs (later passes cache-fed under sharding), the
+    compiled plan's MeshLayout is consumed (`status == "bound"`), the
+    lowered streamed column pass shows the facet-axis all-reduce, and
+    the ``mesh`` artifact block passes `obs.validate_mesh_artifact` —
+    plus the scaling_efficiency sentinel wiring in bench_compare."""
+    out = tmp_path / "BENCH_mesh.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+        BENCH_MESH_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--mesh", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["mesh_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["facet_shards"] == 8
+    assert summary["all_reduce"] >= 1
+
+    # re-validate the artifact out-of-process (the leg's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_mesh_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_mesh_artifact(record) == []
+    mesh = record["mesh"]
+    assert mesh["facet_shards"] == 8
+    assert mesh["n_facets"] == 9 and mesh["padded_facets"] == 16
+    assert mesh["collective_bytes"] > 0
+    assert mesh["match"]["within_tolerance"] is True
+    assert mesh["match"]["max_abs_diff"] <= mesh["match"]["tolerance"]
+    assert mesh["spill"]["complete"] and mesh["forward_passes"] == 1
+    assert mesh["scaling_efficiency"] > 0
+    # the engine consumed the compiled layout — the stub flipped
+    pc = record["plan_compiled"]
+    assert pc["mesh"]["status"] == "bound"
+    assert pc["mesh"]["facet_shards"] == 8
+    assert "mesh.psum" in pc["predicted"]["stages"]
+    assert record["manifest"]["device"]["platform"] == "cpu"
+    assert record["manifest"]["device"]["count"] == 8
+
+    # --- the scaling sentinel (in-process: no extra spawn) ------------
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_mesh_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 0
+    # doctored 2x-better scaling reference -> the sentinel must trip
+    doctored = json.loads(out.read_text())
+    doctored["mesh"]["scaling_efficiency"] = (
+        mesh["scaling_efficiency"] * 2.0
+    )
+    doctored["value"] = record["value"]  # wall unchanged: isolate SE
+    ref.write_text(json.dumps(doctored))
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 1
+
+
 def _run_chaos(tmp_path, extra_args=(), config=None, timeout=540):
     out = tmp_path / "BENCH_chaos.json"
     env = dict(os.environ)

@@ -1,0 +1,320 @@
+"""Mesh-streamed engine (`swiftly_tpu.mesh`): the streamed pipeline
+SPMD over the 8-virtual-device CPU mesh (conftest), pinned against the
+single-chip streamed engine.
+
+Consolidated per the tier-1 budget: each test covers several ISSUE-8
+acceptance axes at the tiny dryrun geometry (N=256; 9 facets over 8
+shards — the facet stack pads 9 -> 16, so UNEVEN padding is exercised
+by construction in every test). The larger 1k-config drill is
+``-m slow``-gated.
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import SwiftlyConfig, make_facet
+from swiftly_tpu.mesh import (
+    MeshStreamedBackward,
+    MeshStreamedForward,
+    make_facet_mesh,
+)
+from swiftly_tpu.parallel import StreamedBackward, StreamedForward
+
+# The dryrun's tiny-but-valid parameter set (see __graft_entry__):
+# 3x3 facet cover, 5x5 subgrid cover, every mesh program shape real.
+PARAMS = dict(
+    W=8.0, fov=1.0, N=256, yB_size=96, yN_size=128, xA_size=56,
+    xM_size=64,
+)
+SOURCES = [(1.0, 3, -5)]
+N_SHARDS = 8
+
+
+@pytest.fixture(scope="module")
+def cover():
+    """(config, facet_configs, facet_tasks, subgrid_configs, mesh)."""
+    from swiftly_tpu import make_full_facet_cover, make_full_subgrid_cover
+
+    config = SwiftlyConfig(backend="jax", **PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    mesh = make_facet_mesh(n_devices=N_SHARDS)
+    return config, facet_configs, facet_tasks, subgrid_configs, mesh
+
+
+def _feed(fwd, bwd, subgrid_configs, spill=None, skip=()):
+    """Stream the cover forward into the backward, group-fed; returns
+    the yielded group arrays (host copies, for stream comparisons)."""
+    groups = []
+    skip = set(skip)
+    for k, (per_col, group) in enumerate(
+        fwd.stream_column_groups(subgrid_configs, spill=spill)
+    ):
+        groups.append(np.asarray(group))
+        if k in skip:
+            continue
+        bwd.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    return groups
+
+
+@pytest.fixture(scope="module")
+def single_chip(cover):
+    """Single-chip streamed reference: (facets, forward group stream)."""
+    config, facet_configs, facet_tasks, subgrid_configs, _mesh = cover
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    groups = _feed(fwd, bwd, subgrid_configs)
+    return bwd.finish(), groups
+
+
+def test_mesh_roundtrip_matches_single_chip_with_spill_feed(
+    cover, single_chip
+):
+    """The tentpole acceptance in one pass: the mesh-streamed round
+    trip over 8 shards (9 facets padded to 16) reproduces the
+    single-chip engine within reduction-order tolerance; the plan
+    compiler's MeshLayout is BOUND by the engine; the facet stack
+    really shards across all 8 devices; the spill cache records the
+    stream under sharding and a cache-fed second pass is BIT-identical
+    to the recorded pass."""
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+    from swiftly_tpu.utils.spill import SpillCache
+
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    ref_facets, ref_groups = single_chip
+
+    plan = compile_plan(
+        PlanInputs.from_cover(
+            config, facet_configs, subgrid_configs, n_devices=N_SHARDS
+        ),
+        mode="roundtrip-streamed",
+    )
+    assert plan.mesh.status == "stub"  # nothing consumed it yet
+    assert plan.mesh.facet_shards == N_SHARDS
+    assert plan.mesh.collective_bytes_total > 0
+
+    mfwd = MeshStreamedForward(
+        config, facet_tasks, layout=plan.mesh, mesh=mesh
+    )
+    # the engine bound the compiled layout and recorded the padding
+    assert plan.mesh.status == "bound"
+    assert plan.mesh.padded_facets == mfwd.stack.n_total == 16
+    assert mfwd.stack.n_real == 9  # uneven: 9 facets over 8 shards
+    assert mfwd.facet_shards == N_SHARDS
+    # the facet stack is genuinely sharded over every device
+    mfwd._upload_resident_facets()
+    assert len(mfwd._dev_facets[0].sharding.device_set) == N_SHARDS
+
+    spill = SpillCache(budget_bytes=1e9)
+    bwd1 = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    groups1 = _feed(mfwd, bwd1, subgrid_configs, spill=spill)
+    facets1 = bwd1.finish()
+    assert spill.complete  # the stream was recorded under sharding
+
+    # forward stream: mesh groups == single-chip groups (the column
+    # pass psum only reorders the facet sum)
+    assert len(groups1) == len(ref_groups)
+    for g_mesh, g_ref in zip(groups1, ref_groups):
+        np.testing.assert_allclose(g_mesh, g_ref, atol=1e-12)
+
+    # backward: mesh facets == single-chip facets (facet-side ops are
+    # shard-local and per-facet identical)
+    np.testing.assert_allclose(facets1, ref_facets, atol=1e-12)
+
+    # cache-fed pass 2: same stream from the spill cache (h2d prefetch
+    # path), bit-identical fold results
+    bwd2 = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    groups2 = _feed(mfwd, bwd2, subgrid_configs, spill=spill)
+    facets2 = bwd2.finish()
+    for g1, g2 in zip(groups1, groups2):
+        np.testing.assert_array_equal(g1, g2)
+    np.testing.assert_array_equal(facets1, facets2)
+
+
+def test_mesh_row_slab_concat_equals_whole(cover, single_chip):
+    """Output-row slabs under sharding: two row-slab passes over the
+    same mesh stream concatenate to the whole-facet backward (the 128k
+    partition axis composed with the facet-shard axis)."""
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    ref_facets, _ = single_chip
+    yB = PARAMS["yB_size"]
+    r_split = 60  # deliberately unaligned with any block size
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    slabs = []
+    for r0, r1 in [(0, r_split), (r_split, yB)]:
+        bwd = MeshStreamedBackward(
+            config, facet_configs, mesh=mesh, row_slab=(r0, r1)
+        )
+        _feed(mfwd, bwd, subgrid_configs)
+        slabs.append(bwd.finish())
+    whole = np.concatenate(slabs, axis=1)
+    np.testing.assert_allclose(whole, ref_facets, atol=1e-12)
+
+
+def test_mesh_checkpoint_records_and_enforces_layout(cover, tmp_path):
+    """Checkpoint meta records the mesh layout; restore onto the SAME
+    sharding resumes to a bit-identical result, restore onto a
+    different layout (single-chip session) refuses loudly."""
+    import json
+    import zlib
+
+    from swiftly_tpu.utils.checkpoint import (
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    # force two column groups so "half-fed" is a group boundary
+    mfwd.col_group = 3
+
+    # uninterrupted run — the reference the resumed run must match
+    bwd_ref = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, bwd_ref, subgrid_configs)
+    want = bwd_ref.finish()
+
+    # feed only group 0, snapshot, and check the meta's mesh block
+    bwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, bwd, subgrid_configs, skip={1})
+    ck = tmp_path / "mesh_bwd.npz"
+    save_streamed_backward_state(ck, bwd)
+    with np.load(ck) as data:
+        meta = json.loads(bytes(data["meta"].tobytes()).decode())
+    assert meta["mesh"] == {
+        "n_devices": N_SHARDS, "facet_shards": N_SHARDS, "axis": "facet",
+    }
+
+    # restore onto the same mesh: accumulator back facet-sharded,
+    # resume the skipped group, finish bit-identical
+    bwd_res = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    processed = restore_streamed_backward_state(ck, bwd_res)
+    assert processed == bwd.processed
+    assert len(bwd_res._acc.sharding.device_set) == N_SHARDS
+    done = set(processed)
+    for per_col, group in mfwd.stream_column_groups(subgrid_configs):
+        keys = [(sg.off0, sg.off1) for col in per_col for _, sg in col]
+        if all(k in done for k in keys):
+            continue
+        bwd_res.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    np.testing.assert_array_equal(bwd_res.finish(), want)
+
+    # a single-chip session must not silently adopt mesh-sharded state
+    bwd_single = StreamedBackward(
+        config, facet_configs, residency="sampled"
+    )
+    with pytest.raises(ValueError, match="mesh"):
+        restore_streamed_backward_state(ck, bwd_single)
+
+    # corrupt-meta snapshots still classify as corruption, not layout
+    # mismatch (the mesh check must not mask CRC failures): flip a byte
+    raw = bytearray(ck.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    (tmp_path / "torn.npz").write_bytes(raw)
+    from swiftly_tpu.utils.checkpoint import CorruptCheckpointError
+
+    with pytest.raises((CorruptCheckpointError, ValueError)):
+        restore_streamed_backward_state(
+            tmp_path / "torn.npz",
+            MeshStreamedBackward(config, facet_configs, mesh=mesh),
+        )
+
+
+def test_plan_mesh_layout_and_validators(cover):
+    """The MeshLayout stub flip (ISSUE-8 satellite): compile_plan with
+    n_devices emits a non-trivial layout priced from the cost model;
+    n_devices=1 stays the trivial stub; validate_plan_artifact accepts
+    both statuses and rejects an unknown one; the mesh engine refuses a
+    layout that disagrees with its mesh."""
+    from swiftly_tpu.obs import validate_plan_artifact
+    from swiftly_tpu.plan import PlanInputs, compile_plan, plan_mesh_layout
+
+    inputs = PlanInputs.from_config("64k[1]-n32k-512", n_devices=4,
+                                    hbm_budget=16e9)
+    layout = plan_mesh_layout(inputs)
+    assert layout.facet_shards == 4
+    assert layout.padded_facets == 12  # 9 facets -> 3 per shard
+    assert layout.per_shard_stack_bytes > 0
+    assert isinstance(layout.fits_hbm, bool)
+    assert layout.collective_bytes_per_column > 0
+    assert (
+        layout.collective_bytes_total
+        > layout.collective_bytes_per_column
+    )
+
+    plan = compile_plan(inputs)
+    assert plan.mesh.status == "stub"
+    record = {"plan_compiled": plan.artifact_block()}
+    assert validate_plan_artifact(record) == []
+    plan.mesh.bind()
+    record = {"plan_compiled": plan.artifact_block()}
+    assert validate_plan_artifact(record) == []
+    assert record["plan_compiled"]["mesh"]["status"] == "bound"
+    # the prediction priced the collective stage for a multi-shard plan
+    assert "mesh.psum" in record["plan_compiled"]["predicted"]["stages"]
+    # and the report names the layout
+    assert "facet shard(s)" in plan.explain()
+
+    plan.mesh.status = "garbage"
+    bad = {"plan_compiled": plan.artifact_block()}
+    assert any("mesh status" in p for p in validate_plan_artifact(bad))
+
+    # single-device: the trivial layout, no collective stage
+    cpu = compile_plan(PlanInputs.from_config("64k[1]-n32k-512"))
+    assert cpu.mesh.facet_shards == 1 and cpu.mesh.status == "stub"
+    assert cpu.mesh.collective_bytes_total == 0
+    assert "mesh.psum" not in cpu.predicted["stages"]
+
+    # engine/layout shard-count mismatch fails loudly
+    config, facet_configs, facet_tasks, _sg, mesh = cover
+    wrong = plan_mesh_layout(
+        PlanInputs.from_cover(config, facet_configs, _sg, n_devices=2)
+    )
+    with pytest.raises(ValueError, match="facet shard"):
+        MeshStreamedForward(
+            config, facet_tasks, layout=wrong, mesh=mesh
+        )
+
+
+@pytest.mark.slow
+def test_mesh_engine_1k_drill():
+    """The larger drill at the 1k catalogue config (the bench --mesh
+    smoke geometry): mesh-streamed round trip over 8 shards within
+    reduction-order tolerance of single-chip, planar f32."""
+    import jax.numpy as jnp
+
+    from swiftly_tpu import (
+        SWIFT_CONFIGS,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+        make_real_facet,
+    )
+
+    params = dict(SWIFT_CONFIGS["1k[1]-n512-256"])
+    params.setdefault("fov", 1.0)
+    config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_real_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+    fwd = StreamedForward(config, facet_tasks, residency="device")
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    _feed(fwd, bwd, subgrid_configs)
+    ref = bwd.finish()
+
+    mesh = make_facet_mesh(n_devices=N_SHARDS)
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    mbwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, mbwd, subgrid_configs)
+    got = mbwd.finish()
+    scale = float(np.max(np.abs(ref)))
+    assert float(np.max(np.abs(got - ref))) <= 5e-5 * scale
