@@ -754,6 +754,9 @@ def run_one(config_name, mode):
                     os.environ.get("BENCH_BWD_ROW_SLABS", "0")
                 ),
                 allow_spill=os.environ.get("BENCH_SPILL", "1") != "0",
+                feed_env=int(
+                    os.environ.get("BENCH_BWD_FEED_GROUP", "0")
+                ),
             )
             fold_group[0] = cplan.backward.fold_group
             plan_state["plan"] = cplan
@@ -833,21 +836,30 @@ def run_one(config_name, mode):
             scalar pull forces completion of the whole graph. When the
             accumulator exceeds HBM the backward runs in facet-subset x
             row-slab passes (same total backward work); the subgrid
-            stream is persisted ONCE by the spill cache, so the whole
-            partitioned round trip costs 1 forward + len(parts)
-            cache-fed backward passes (counter-asserted via
-            `fwd.passes`). A stream too large for the cache budget
-            falls back to forward replay per pass — exact, just the
-            pre-cache cost model."""
+            stream is persisted ONCE by the spill cache and the passes
+            run under the plan's FEED-ONCE/FOLD-MANY schedule
+            (`feed_backward_passes`): `feed_group` passes share each
+            pass over the stream, so the whole partitioned round trip
+            costs 1 forward + (n_feeds - 1) cache-fed feeds instead of
+            1 + (n_passes - 1) (counter-asserted via `fwd.passes`; the
+            h2d collapse shows in `spill.h2d` bytes). A stream too
+            large for the cache budget falls back to forward replay per
+            FEED — exact, and the schedule shrinks even that cost."""
+            from swiftly_tpu.parallel import feed_backward_passes
+
             parts, resident = _make_plan()
             cplan = plan_state["plan"]
-            fwd.hbm_headroom = int(resident + reserve)
-            n_facet_passes = len({(p[0], p[1]) for p in parts})
-            n_row_slabs = len({(p[2], p[3]) for p in parts})
+            feed_q = min(cplan.backward.feed_group, len(parts))
+            # the feed's shared accumulators all sit on the chip during
+            # the fill feed: the forward's sizers must leave room for
+            # every pass in the largest feed chunk, not just one
+            fwd.hbm_headroom = int(feed_q * resident + reserve)
             extra["bwd_plan"] = {
                 "n_passes": len(parts),
-                "n_facet_passes": n_facet_passes,
-                "n_row_slabs": n_row_slabs,
+                "n_facet_passes": len({(p[0], p[1]) for p in parts}),
+                "n_row_slabs": len({(p[2], p[3]) for p in parts}),
+                "feed_group": feed_q,
+                "n_feeds": cplan.backward.n_feeds,
             }
             # the spill policy (cache budget, RAM/disk/replay) is the
             # compiled plan's third output — SpillCache no longer prices
@@ -856,11 +868,18 @@ def run_one(config_name, mode):
                 cplan.spill.make_cache() if cplan.spill.use_spill
                 else None
             )
-            passes0 = 0
+            passes0 = feeds0 = h2d0 = 0
             if metrics.enabled():
-                passes0 = (metrics.export().get("counters") or {}).get(
+                exp0 = metrics.export()
+                passes0 = (exp0.get("counters") or {}).get(
                     "fwd.passes", 0
                 )
+                feeds0 = (exp0.get("counters") or {}).get(
+                    "bwd.feed_groups", 0
+                )
+                h2d0 = (
+                    (exp0.get("stages") or {}).get("spill.h2d") or {}
+                ).get("bytes", 0)
             max_rms2 = 0.0
             extra["pass_s"] = []
             hb = Heartbeat(
@@ -871,49 +890,70 @@ def run_one(config_name, mode):
             )
             from swiftly_tpu.obs import trace as otrace
 
-            for kpart, (i0, i1, r0, r1) in enumerate(parts):
+            chunks = [
+                parts[c0 : c0 + feed_q]
+                for c0 in range(0, len(parts), feed_q)
+            ]
+            for kfeed, chunk in enumerate(chunks):
                 t_pass = time.time()
-                # the hierarchy's pass level: leg → PASS → column
-                # group → stage (one span per facet x row-slab part)
+                # the hierarchy's pass level: leg → PASS (one shared
+                # feed of feed_group facet x row-slab parts) → feed
+                # group → column group → stage
                 pass_span = otrace.span(
-                    "bwd.pass", cat="bench", part=kpart,
-                    facets=[i0, i1], rows=[r0, r1],
+                    "bwd.pass", cat="bench", feed=kfeed,
+                    parts=[list(p) for p in chunk],
                 )
                 pass_span.__enter__()
-                bwd = StreamedBackward(
-                    config, list(facet_configs[i0:i1]),
-                    residency="sampled", fold_group=fold_group[0],
-                    row_slab=(r0, r1) if (r0, r1) != (0, yB) else None,
-                )
-                # group feeding: one vmapped column pass + one fold per
-                # forward column group (per-column feeding pays the
-                # per-dispatch tunnel latency 2G+ times per group);
-                # pass 1 records the stream, later passes are cache-fed
-                for per_col, group in fwd.stream_column_groups(
-                    subgrid_configs, spill=spill
-                ):
-                    bwd.add_subgrid_group(
-                        [[sg for _, sg in col] for col in per_col], group
+                bwds = [
+                    StreamedBackward(
+                        config, list(facet_configs[i0:i1]),
+                        residency="sampled", fold_group=fold_group[0],
+                        row_slab=(
+                            (r0, r1) if (r0, r1) != (0, yB) else None
+                        ),
                     )
-                    hb.update(sum(len(col) for col in per_col))
-                facets_dev = bwd.finish_device()
-                rms2 = _verify_part(facets_dev, i0, i1, r0, r1)
-                max_rms2 = max(max_rms2, float(np.asarray(jnp.max(rms2))))
-                del facets_dev, bwd
+                    for i0, i1, r0, r1 in chunk
+                ]
+                # feed-once/fold-many: ONE pass over the (cached)
+                # stream serves every backward in the chunk — group
+                # feeding inside (one vmapped column pass + one fold
+                # per forward column group per pass); feed 1 records
+                # the stream, later feeds are cache-fed
+                feed_backward_passes(
+                    fwd, subgrid_configs, bwds, spill=spill,
+                    progress=hb.update,
+                )
+                for bwd, (i0, i1, r0, r1) in zip(bwds, chunk):
+                    facets_dev = bwd.finish_device()
+                    rms2 = _verify_part(facets_dev, i0, i1, r0, r1)
+                    max_rms2 = max(
+                        max_rms2, float(np.asarray(jnp.max(rms2)))
+                    )
+                    del facets_dev
+                del bwds
                 pass_span.__exit__(None, None, None)
                 extra["pass_s"].append(round(time.time() - t_pass, 1))
-                if len(parts) > 1:
+                if len(chunks) > 1:
                     log.info(
-                        "roundtrip pass %d/%d (facets %d:%d rows %d:%d)"
-                        " done",
-                        kpart + 1, len(parts), i0, i1, r0, r1,
+                        "roundtrip feed %d/%d (%d pass(es)) done",
+                        kfeed + 1, len(chunks), len(chunk),
                     )
             if spill is not None:
                 extra["spill"] = spill.stats()
             if metrics.enabled():
+                exp1 = metrics.export()
                 extra["forward_passes"] = (
-                    metrics.export().get("counters") or {}
+                    exp1.get("counters") or {}
                 ).get("fwd.passes", 0) - passes0
+                # this run's feed-schedule execution, as deltas (the
+                # warmup run shares the registry): feeds run and the
+                # cache-fed h2d bytes the schedule actually moved
+                extra["feed_groups"] = (
+                    exp1.get("counters") or {}
+                ).get("bwd.feed_groups", 0) - feeds0
+                extra["spill_h2d_bytes"] = (
+                    (exp1.get("stages") or {}).get("spill.h2d") or {}
+                ).get("bytes", 0) - h2d0
             return max_rms2 ** 0.5
 
         t0 = time.time()
@@ -2155,22 +2195,32 @@ def mesh_bench(smoke_mode=False):
             "fwd.passes", 0
         )
 
+    # feed-once/fold-many parity: the mesh backward consumes the SAME
+    # schedule helper as the single-chip leg (one shared feed per chunk
+    # of `feed_group` facet-subset passes). Default 1 keeps the
+    # cache-fed feed exercised under sharding (a single shared feed
+    # would never re-read the cache).
+    feed_group_env = max(
+        1, int(os.environ.get("BENCH_BWD_FEED_GROUP", "1"))
+    )
+
     def roundtrip(fwd_exec, make_bwd):
         """Spill-cached facet-partitioned round trip: ONE forward pass
-        records the stream, every later facet-subset pass is cache-fed
-        (identical shape to `run_one`'s roundtrip-streamed leg)."""
+        records the stream, every later facet-subset FEED is cache-fed
+        (identical shape to `run_one`'s roundtrip-streamed leg,
+        including the feed-once/fold-many schedule)."""
+        from swiftly_tpu.parallel import feed_backward_passes
+
         spill = SpillCache(budget_bytes=2e9)
         parts = []
         t0 = time.time()
-        for i0, i1 in subsets:
-            bwd = make_bwd(i0, i1)
-            for per_col, group in fwd_exec.stream_column_groups(
-                subgrid_configs, spill=spill
-            ):
-                bwd.add_subgrid_group(
-                    [[sg for _, sg in col] for col in per_col], group
-                )
-            parts.append(np.asarray(bwd.finish()))
+        for c0 in range(0, len(subsets), feed_group_env):
+            chunk = subsets[c0 : c0 + feed_group_env]
+            bwds = [make_bwd(i0, i1) for i0, i1 in chunk]
+            feed_backward_passes(
+                fwd_exec, subgrid_configs, bwds, spill=spill
+            )
+            parts.extend(np.asarray(bwd.finish()) for bwd in bwds)
         wall = time.time() - t0
         return np.concatenate(parts, axis=0), wall, spill
 
@@ -2364,8 +2414,12 @@ def smoke():
     os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
     # force a 2-pass facet-partitioned backward so the spill-cache path
     # (fill + cache-fed pass) and its artifact fields are exercised on
-    # CPU — the single-pass plan would never touch the cache
+    # CPU — the single-pass plan would never touch the cache. Feed
+    # group pinned to 1 (per-pass feeding) for the same reason: CPU's
+    # unlimited budget would share ONE feed across both passes and the
+    # cache-fed h2d path (prefetch hits, spill.h2d) would never run
     os.environ.setdefault("BENCH_BWD_FACET_PASSES", "2")
+    os.environ.setdefault("BENCH_BWD_FEED_GROUP", "1")
     metrics.enable(jsonl_path)
     name = os.environ.get("BENCH_SMOKE_CONFIG", "1k[1]-n512-256")
     record = run_one(name, "roundtrip-streamed")
@@ -2426,6 +2480,37 @@ def smoke():
         )
     if "measured_wall_s" not in pc:
         problems.append("plan_compiled missing measured_wall_s")
+    # feed-once/fold-many schema: the executed schedule must match the
+    # compiled one, the shared-feed stage must have been recorded, and
+    # the h2d byte collapse must be exactly what the schedule promises
+    # ((n_feeds - 1) x the recorded stream) — asserted from telemetry,
+    # not inferred
+    if (pc.get("backward") or {}).get("feed_group") != bwd_plan.get(
+        "feed_group"
+    ):
+        problems.append(
+            f"compiled plan feed_group {pc.get('backward')} disagrees "
+            f"with the executed bwd_plan {bwd_plan}"
+        )
+    n_feeds = bwd_plan.get("n_feeds") or 0
+    if record.get("feed_groups") != n_feeds:
+        problems.append(
+            f"executed feed_groups {record.get('feed_groups')} != "
+            f"planned n_feeds {n_feeds}"
+        )
+    if "bwd.feed_group" not in stages:
+        problems.append("telemetry missing the bwd.feed_group stage")
+    stream_bytes = (record.get("spill") or {}).get("ram_bytes", 0) + (
+        record.get("spill") or {}
+    ).get("disk_bytes", 0)
+    if stream_bytes and n_feeds:
+        want = (n_feeds - 1) * stream_bytes
+        if record.get("spill_h2d_bytes") != want:
+            problems.append(
+                f"spill.h2d moved {record.get('spill_h2d_bytes')} "
+                f"bytes; the feed schedule promises (n_feeds-1) x "
+                f"stream = {want}"
+            )
     import json as _json
 
     with open(jsonl_path) as fh:

@@ -12,7 +12,14 @@ artifact) and exits non-zero when a leg regressed:
   ``--threshold`` (default 20%) SLOWER than the best reference for the
   same (config, mode);
 * **MFU** — latest ``mfu_pct`` more than the threshold BELOW the best
-  reference;
+  (highest) reference. This is the ROUND-TRIP MFU sentinel for the
+  backward-path recovery arc (ROADMAP item 2): ``roundtrip-streamed``
+  legs stamp whole-trip MFU (forward + backward FLOPs over the round
+  trip's wall), so the 5.5% → 26%-class climb the feed-once/fold-many
+  schedule buys is regression-guarded leg-by-leg — higher is better,
+  cross-platform pairs are skipped (below), and the doctored-reference
+  trip is exercised in tier-1 (tests/test_bench_smoke.py) exactly like
+  the mesh scaling sentinel;
 * **p99 / QPS** — for serving legs (``--serve`` / ``--fleet``
   artifacts): latest ``p99_ms`` more than the threshold above the best
   (lowest) reference p99, or ``throughput_rps`` more than the

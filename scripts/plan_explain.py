@@ -20,6 +20,14 @@ the fold group) by predicted wall and the report shows the refit
 coefficients; without it the static defaults only RANK alternatives
 and the seed heuristics keep the choice.
 
+The report includes the backward FEED SCHEDULE (feed-once/fold-many,
+`plan.plan_backward_feed`): how many facet x row-slab passes share each
+pass over the subgrid stream, the ``spill.h2d`` bytes that sharing
+removes vs per-pass feeding, and whether the adjoint-fold compute is
+predicted to hide the feed entirely (the h2d/compute overlap).
+``--feed-group`` forces passes-per-feed, mirroring bench's
+``BENCH_BWD_FEED_GROUP``.
+
 Exit: 0 on a printed plan, 2 on a bad config/inputs.
 """
 
@@ -81,6 +89,12 @@ def main(argv=None):
         help="serve coalescing cap for the bucket shapes (default 64)",
     )
     ap.add_argument(
+        "--feed-group", type=int, default=0,
+        help="force passes-per-feed for the feed-once/fold-many "
+             "backward schedule (default 0: sized from the budget; "
+             "bench's BENCH_BWD_FEED_GROUP)",
+    )
+    ap.add_argument(
         "--history", action="append", default=[], metavar="GLOB",
         help="artifact path/glob for plan.autotune.refit; repeatable. "
              "Measured coefficients unlock parameter selection by "
@@ -122,7 +136,7 @@ def main(argv=None):
     coeffs = refit(args.history) if args.history else None
     plan = compile_plan(
         inputs, coeffs=coeffs, mode=args.mode,
-        spill_dir=args.spill_dir,
+        spill_dir=args.spill_dir, feed_env=args.feed_group,
     )
     if args.as_json:
         print(json.dumps(plan.artifact_block(), indent=2))

@@ -28,6 +28,12 @@ turns those pieces into a mesh-streamed ENGINE:
   `host_gather`): on a multi-host pod slice each process pulls its own
   shards (or any one replica of a replicated output) instead of
   addressing devices it cannot reach.
+* The multi-chip backward consumes the SAME feed-once/fold-many
+  schedule as the single-chip engine: the engines speak the streamed
+  API, so `parallel.streamed.feed_backward_passes` drives shared feeds
+  over `MeshStreamedForward`/`MeshStreamedBackward` unchanged (the
+  plan's ``backward.feed_group`` sizes the chunk; ``bench.py --mesh``
+  routes both its single-chip reference and the mesh run through it).
 
 Exactness contract: per-facet math is byte-identical to the single-chip
 engine (the shard_map bodies are built from the same ``*_fn`` builders);

@@ -407,7 +407,7 @@ PLAN_ARTIFACT_FIELDS = (
 
 _PLAN_BACKWARD_FIELDS = (
     "n_passes", "n_facet_passes", "n_row_slabs", "fold_group",
-    "resident_bytes",
+    "feed_group", "n_feeds", "resident_bytes",
 )
 
 _PLAN_SPILL_MODES = ("none", "ram", "disk", "replay")
@@ -449,6 +449,20 @@ def validate_plan_artifact(record):
                 f"plan pass grid incoherent: {n} passes != "
                 f"{nf} facet passes x {nr} row slabs"
             )
+        # feed-once/fold-many schedule coherence: q in [1, n_passes]
+        # and n_feeds == ceil(n_passes / q) — a schedule that disagrees
+        # with its own grid would mis-size every feed's residency
+        q, nfe = bwd.get("feed_group"), bwd.get("n_feeds")
+        if all(isinstance(v, int) for v in (n, q, nfe)):
+            if not (1 <= q <= max(1, n)):
+                problems.append(
+                    f"plan feed_group {q} outside [1, {n}] passes"
+                )
+            elif nfe != -(-n // q):
+                problems.append(
+                    f"plan feed schedule incoherent: {nfe} feeds != "
+                    f"ceil({n} passes / {q} per feed)"
+                )
     elif "backward" in block:
         problems.append("plan backward block is not a dict")
     spill = block.get("spill")

@@ -10,10 +10,24 @@ of exactly the kind the reference delegates to its native C library
 (/root/reference/src/ska_sdp_exec_swiftly/fourier_transform/core.py:487-929,
 the `ska-sdp-func` fast path).
 
+The second kernel, `bwd_fold_pallas`, fuses the streamed backward's
+adjoint sampled fold (`parallel.streamed._bwd_sampled_fold_fn`): per
+output-row block the fold runs TWO phase-matrix matmuls, a row-weight
+scale, and an accumulate into the image accumulator — as XLA einsums
+the accumulator block and both row planes stream through HBM once per
+product. The kernel keeps the accumulator block in VMEM across the
+whole contraction grid (initialised from the incoming block, scaled
+partial products added in place), so each (rows, acc) block pair is
+read once per output tile — the hot loop the reference delegates to
+its native ``ska-sdp-func`` library, here as one Mosaic grid program.
+
 Usage is opt-in (``SWIFTLY_PALLAS=1``): correctness is validated in
 interpreter mode on any backend (tests/test_pallas.py), but this
 environment's remote-compile TPU relay cannot compile Mosaic kernels, so
 the default planar path stays on plain XLA einsums.
+``SWIFTLY_PALLAS_INTERPRET=1`` additionally forces the Pallas
+interpreter at trace time — the CPU-tier escape hatch that lets the
+full fold path run (and be equivalence-tested) without Mosaic.
 """
 
 from __future__ import annotations
@@ -25,12 +39,19 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["cmatmul_pallas", "pallas_enabled"]
+__all__ = ["bwd_fold_pallas", "cmatmul_pallas", "pallas_enabled",
+           "pallas_interpret"]
 
 
 def pallas_enabled() -> bool:
     """True when the Pallas fast path is requested via SWIFTLY_PALLAS=1."""
     return os.environ.get("SWIFTLY_PALLAS", "0") == "1"
+
+
+def pallas_interpret() -> bool:
+    """True when SWIFTLY_PALLAS_INTERPRET=1 asks for interpreter-mode
+    Pallas (any backend; used by the CPU tier-1 equivalence tests)."""
+    return os.environ.get("SWIFTLY_PALLAS_INTERPRET", "0") == "1"
 
 
 def _kernel(zr_ref, zi_ref, wr_ref, wi_ref, or_ref, oi_ref):
@@ -102,3 +123,85 @@ def cmatmul_pallas(zr, zi, wr, wi, *, bm=256, bn=256, bk=256,
         interpret=interpret,
     )(zr_p, zi_p, wr_p, wi_p)
     return outr[:B, :N], outi[:B, :N]
+
+
+def _fold_kernel(ar_ref, ai_ref, bc_ref, bs_ref, rr_ref, ri_ref, w_ref,
+                 or_ref, oi_ref):
+    """One adjoint-fold output tile: out = acc + w * (Bcᵀ@Rr + Bsᵀ@Ri,
+    Bcᵀ@Ri − Bsᵀ@Rr). The accumulator tile loads into VMEM once (k==0)
+    and every contraction step's weighted partial product adds in place
+    — no HBM round trip per product, which is the whole point."""
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        or_ref[...] = ar_ref[...]
+        oi_ref[...] = ai_ref[...]
+
+    bc = bc_ref[...]  # [bk, bm] block of the phase matrix
+    bs = bs_ref[...]
+    rr = rr_ref[...]  # [bk, bn] block of the rotated row planes
+    ri = ri_ref[...]
+    w = w_ref[...]    # [bm, 1] row weights (Fb window x keep mask)
+    # contract over axis 0 of BOTH operands (the fold's "r" index);
+    # HIGHEST matches the einsum fold's matmul_precision default
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=or_ref.dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    or_ref[...] += w * (dot(bc, rr) + dot(bs, ri))
+    oi_ref[...] += w * (dot(bc, ri) - dot(bs, rr))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bm", "bn", "bk", "interpret")
+)
+def bwd_fold_pallas(acc_r, acc_i, bc, bs, rr, ri, w, *, bm=256, bn=256,
+                    bk=256, interpret=False):
+    """Fused adjoint-fold block: acc + w ⊙ ((Bc − i·Bs)ᵀ @ (Rr + i·Ri)).
+
+    The planar sampled fold's per-block einsum pair plus accumulate as
+    ONE grid program (see `parallel.streamed._bwd_sampled_fold_fn`'s
+    Pallas body, which flattens the facet axis into the j axis before
+    calling here).
+
+    :param acc_r, acc_i: [B, J] accumulator planes (the current block)
+    :param bc, bs: [R, B] adjoint DFT phase planes for the block's
+        output rows (cos/sin of −kt·i)
+    :param rr, ri: [R, J] phase-rotated row planes (facet axis folded
+        into J)
+    :param w: [B, 1] per-output-row weight (Fb window × keep mask)
+    :param bm, bn, bk: tile sizes (rows, output, contraction)
+    :param interpret: run in the Pallas interpreter (any backend)
+    """
+    B, J = acc_r.shape
+    R = bc.shape[0]
+    bm, bn, bk = min(bm, B), min(bn, J), min(bk, R)
+
+    ar_p = _pad_to(_pad_to(acc_r, bm, 0), bn, 1)
+    ai_p = _pad_to(_pad_to(acc_i, bm, 0), bn, 1)
+    bc_p = _pad_to(_pad_to(bc, bk, 0), bm, 1)
+    bs_p = _pad_to(_pad_to(bs, bk, 0), bm, 1)
+    rr_p = _pad_to(_pad_to(rr, bk, 0), bn, 1)
+    ri_p = _pad_to(_pad_to(ri, bk, 0), bn, 1)
+    w_p = _pad_to(w, bm, 0)
+    Bp, Jp = ar_p.shape
+    Rp = bc_p.shape[0]
+
+    grid = (Bp // bm, Jp // bn, Rp // bk)
+    a_spec = pl.BlockSpec((bm, bn), lambda i, j, k: (i, j))
+    b_spec = pl.BlockSpec((bk, bm), lambda i, j, k: (k, i))
+    r_spec = pl.BlockSpec((bk, bn), lambda i, j, k: (k, j))
+    w_spec = pl.BlockSpec((bm, 1), lambda i, j, k: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((Bp, Jp), acc_r.dtype)
+
+    outr, outi = pl.pallas_call(
+        _fold_kernel,
+        grid=grid,
+        in_specs=[a_spec, a_spec, b_spec, b_spec, r_spec, r_spec, w_spec],
+        out_specs=[a_spec, a_spec],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(ar_p, ai_p, bc_p, bs_p, rr_p, ri_p, w_p)
+    return outr[:B, :J], outi[:B, :J]

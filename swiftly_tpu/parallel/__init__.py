@@ -29,13 +29,19 @@ from .sharded import (
     subgrid_from_columns_sharded,
     subgrids_from_columns_sharded,
 )
-from .streamed import CachedColumnFeed, StreamedBackward, StreamedForward
+from .streamed import (
+    CachedColumnFeed,
+    StreamedBackward,
+    StreamedForward,
+    feed_backward_passes,
+)
 
 __all__ = [
     "CachedColumnFeed",
     "FACET_AXIS",
     "StreamedBackward",
     "StreamedForward",
+    "feed_backward_passes",
     "backward_all_sharded",
     "batched",
     "facet_sharding",

@@ -73,7 +73,7 @@ from .batched import (
     finish_masked_subgrid,
 )
 
-__all__ = ["StreamedForward", "StreamedBackward"]
+__all__ = ["StreamedForward", "StreamedBackward", "feed_backward_passes"]
 
 
 def _planar(core):
@@ -1174,7 +1174,7 @@ def _fold_row_block(F, yB, itemsize):
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_sampled_fold_fn(core):
+def _bwd_sampled_fold_fn(core, use_pallas=False, interpret=False):
     """acc [F, yB, yB(,2)] += adjoint-sampled fold of rows [F, R, yB(,2)].
 
     `rows` are a column group's NAF_BMNAF rows concatenated along R (the
@@ -1198,6 +1198,17 @@ def _bwd_sampled_fold_fn(core):
     into HBM-sized row slabs, each an independent backward pass over
     the same subgrid stream. Whole-facet callers pass row0 = 0; the
     full facet width is read off the rows' pass-through j axis.
+
+    With ``use_pallas`` (planar only; `ops.pallas_kernels.pallas_enabled`
+    resolves the opt-in at trace time like SWIFTLY_COLPASS) each block's
+    einsum pair + row-weight scale + accumulate runs as ONE fused
+    `bwd_fold_pallas` grid program with the accumulator block pinned in
+    VMEM — the facet axis folds into the kernel's j axis, so the fused
+    matmuls stay MXU-deep at any facet count. The fused kernel tiles
+    the contraction, so its partial-sum ORDER differs from the einsum
+    body: results agree to f32 sum-reorder tolerance (~1e-5 relative,
+    pinned by tests/test_pallas.py), not bit-identically. ``interpret``
+    routes through the Pallas interpreter (CPU validation).
     """
     import jax.numpy as jnp
 
@@ -1207,7 +1218,83 @@ def _bwd_sampled_fold_fn(core):
         theta = (2 * np.pi / yN) * residues
         return jnp.cos(theta), jnp.sin(theta)
 
-    if _planar(core):
+    if use_pallas and not _planar(core):  # pragma: no cover - guarded
+        raise ValueError("the Pallas fold requires the planar backend")
+
+    if _planar(core) and use_pallas:
+        from ..ops.pallas_kernels import bwd_fold_pallas
+
+        def fn(acc, rows, e0, krows, row0):
+            F, Rs = acc.shape[0], acc.shape[1]
+            yB = rows.shape[2]  # full facet width (pass-through j axis)
+            R = rows.shape[1]
+            dt = acc.dtype
+            fb = core._p.extract_mid(core._Fb, yB, 0)  # [yB] real
+            p_cos, p_sin = phases(
+                _mulmod(e0.astype(jnp.int32)[:, None], krows[None, :], yN)
+            )
+            p_cos = p_cos.astype(dt)[..., None]
+            p_sin = p_sin.astype(dt)[..., None]
+            Rr, Ri = rows[..., 0], rows[..., 1]
+            # the [R, F*yB] layout folds the facet axis into the kernel's
+            # output-column axis (hoisted out of the block scan — the
+            # rotated planes are block-invariant)
+            rr_flat = jnp.moveaxis(
+                Rr * p_cos + Ri * p_sin, 0, 1
+            ).reshape(R, F * yB)
+            ri_flat = jnp.moveaxis(
+                Ri * p_cos - Rr * p_sin, 0, 1
+            ).reshape(R, F * yB)
+            B = min(_fold_row_block(F, yB, np.dtype(dt).itemsize), Rs)
+            n_blk = -(-Rs // B)
+            fbj = jnp.asarray(fb, dt)
+
+            def body(carry, xs):
+                i0, start = xs
+                ii = start + jnp.arange(B, dtype=jnp.int32)  # slab-rel
+                keep = (ii >= i0).astype(dt)
+                i_abs = row0 + ii  # absolute row: phases + Fb weight
+                b_cos, b_sin = phases(
+                    _mulmod(krows[:, None], i_abs[None, :], yN)
+                )
+                w = (
+                    jax.lax.dynamic_slice_in_dim(fbj, row0 + start, B)
+                    * keep
+                )
+                z = jnp.int32(0)
+                cur = jax.lax.dynamic_slice(
+                    carry, (z, start, z, z), (F, B, yB, 2)
+                )
+                out_r, out_i = bwd_fold_pallas(
+                    jnp.moveaxis(cur[..., 0], 0, 1).reshape(B, F * yB),
+                    jnp.moveaxis(cur[..., 1], 0, 1).reshape(B, F * yB),
+                    b_cos.astype(dt),
+                    b_sin.astype(dt),
+                    rr_flat,
+                    ri_flat,
+                    w[:, None].astype(dt),
+                    interpret=interpret,
+                )
+                new = jnp.stack(
+                    [
+                        jnp.moveaxis(out_r.reshape(B, F, yB), 0, 1),
+                        jnp.moveaxis(out_i.reshape(B, F, yB), 0, 1),
+                    ],
+                    axis=-1,
+                )
+                return (
+                    jax.lax.dynamic_update_slice(
+                        carry, new, (z, start, z, z)
+                    ),
+                    None,
+                )
+
+            i0s = jnp.arange(n_blk, dtype=jnp.int32) * B
+            starts = jnp.minimum(i0s, Rs - B)
+            acc, _ = jax.lax.scan(body, acc, (i0s, starts))
+            return acc
+
+    elif _planar(core):
 
         def fn(acc, rows, e0, krows, row0):
             F, Rs = acc.shape[0], acc.shape[1]
@@ -1320,10 +1407,25 @@ def _bwd_sampled_fold_fn(core):
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_sampled_fold_j(core):
+def _bwd_sampled_fold_j(core, use_pallas=False, interpret=False):
     return _jit(donate=(0,))(
-        _scoped("swiftly/bwd.sampled_fold", _bwd_sampled_fold_fn(core))
+        _scoped(
+            "swiftly/bwd.sampled_fold",
+            _bwd_sampled_fold_fn(core, use_pallas, interpret),
+        )
     )
+
+
+def resolve_fold_kernel(core, meshed=False) -> str:
+    """Sampled-fold kernel body: "pallas" when the opt-in
+    (SWIFTLY_PALLAS=1) applies — planar backend, single device — else
+    "einsum". Read at trace time like SWIFTLY_COLPASS (the lru-cached
+    jits bake the choice in)."""
+    from ..ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled() and _planar(core) and not meshed:
+        return "pallas"
+    return "einsum"
 
 
 @functools.lru_cache(maxsize=None)
@@ -2488,33 +2590,83 @@ class StreamedForward:
     def _replay_spilled_groups(self, spill):
         """Yield the cached stream with double-buffered h2d prefetch:
         group k+1's upload is DISPATCHED before group k is yielded, so
-        the wire runs under the consumer's compute on group k."""
+        the wire runs under the consumer's compute on group k.
+
+        The host-side cache read of group k+1 (a disk read for
+        disk-backed entries — the serial cost that used to sit between
+        yields, blocking the consumer's fold dispatch) additionally runs
+        on a background thread while the consumer computes on group k
+        (``SWIFTLY_SPILL_PREFETCH=0`` disables the thread; the read
+        then happens inline exactly as before). Failure semantics are
+        unchanged: a read that stays failed past its retries raises
+        HERE, before the previous group's yield, so the caller's
+        replay-fallback resumes at the right group."""
+        import concurrent.futures
+
         import jax.numpy as jnp
 
+        import os
+
+        use_thread = (
+            os.environ.get("SWIFTLY_SPILL_PREFETCH", "1") != "0"
+            and len(spill) > 1
+        )
+        tctx = _trace.current()
+
+        def read(k):
+            # worker threads adopt the caller's span so the spill.read
+            # stage nests under the right feed in the timeline
+            if _trace.current() != tctx:
+                _trace.adopt(tctx)
+            with _metrics.stage("spill.read") as st:
+                host = spill.get(k)
+                st.bytes_moved = int(host.nbytes)
+            return host
+
+        ex = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="swiftly-spill-read"
+            )
+            if use_thread
+            else None
+        )
         pending = None
-        for k in range(len(spill)):
-            # the feed's group span closes before the yield (generator
-            # contextvars leak to the consumer between yields)
-            with _trace.span("spill.feed_group", cat="spill", group=k):
-                with _metrics.stage("spill.read") as st:
-                    host = spill.get(k)
-                    st.bytes_moved = int(host.nbytes)
+        try:
+            fut = ex.submit(read, 0) if ex is not None else None
+            for k in range(len(spill)):
+                # the feed's group span closes before the yield (generator
+                # contextvars leak to the consumer between yields)
+                with _trace.span("spill.feed_group", cat="spill", group=k):
+                    if fut is not None:
+                        host = fut.result()
+                        fut = (
+                            ex.submit(read, k + 1)
+                            if k + 1 < len(spill)
+                            else None
+                        )
+                        if _metrics.enabled():
+                            _metrics.count("spill.async_reads")
+                    else:
+                        host = read(k)
 
-                def upload():
-                    _fault_point("transfer.h2d")
-                    with _metrics.stage("spill.h2d") as st:
-                        arr = jnp.asarray(host)
-                        st.bytes_moved = int(host.nbytes)
-                    return arr
+                    def upload():
+                        _fault_point("transfer.h2d")
+                        with _metrics.stage("spill.h2d") as st:
+                            arr = jnp.asarray(host)
+                            st.bytes_moved = int(host.nbytes)
+                        return arr
 
-                dev = _retry(upload, site="transfer.h2d")
-            if _metrics.enabled():
-                _metrics.count("spill.prefetch_hits")
+                    dev = _retry(upload, site="transfer.h2d")
+                if _metrics.enabled():
+                    _metrics.count("spill.prefetch_hits")
+                if pending is not None:
+                    yield pending
+                pending = (spill.meta(k), dev)
             if pending is not None:
                 yield pending
-            pending = (spill.meta(k), dev)
-        if pending is not None:
-            yield pending
+        finally:
+            if ex is not None:
+                ex.shutdown(wait=False, cancel_futures=True)
 
     def stream_columns(self, subgrid_configs, device_arrays=False):
         """Yield (col_items, subgrids) per column; one device program each.
@@ -3329,6 +3481,86 @@ def col_group_for_budget(base, budget, n_cols, real=False,
 
 
 # ---------------------------------------------------------------------------
+# Feed-once/fold-many scheduling
+# ---------------------------------------------------------------------------
+
+
+def feed_backward_passes(forward, subgrid_configs, backwards, spill=None,
+                         progress=None):
+    """Feed ONE pass over the subgrid stream to MANY backward passes.
+
+    A facet × row-slab partitioned backward runs P independent
+    `StreamedBackward` passes over the SAME subgrid stream; feeding each
+    pass separately moves the whole cached stream host→device P times
+    (the 64k ledger's dominant waste after the spill cache removed the
+    forward replays). This helper is the feed-once/fold-many schedule:
+    each cached column group is uploaded ONCE and every pending pass's
+    adjoints for that group are applied on-device before the stream
+    advances — (len(backwards) − 1)× of the feed's ``spill.h2d`` bytes
+    gone. How many passes may share a feed is a plan decision
+    (`plan.compiler.plan_backward_feed` sizes it so all the shared
+    accumulators + fold pipelines fit the HBM budget next to the feed's
+    working set); the caller chunks its pass list accordingly and calls
+    this once per chunk.
+
+    Works with any forward/backward pair that speaks the streamed API
+    (`stream_column_groups` / `add_subgrid_group`) — the mesh engines
+    (`swiftly_tpu.mesh`) inherit it, so the multi-chip backward consumes
+    the same schedule.
+
+    Instrumentation: the whole shared feed is one ``bwd.feed_group``
+    trace span, and a ``bwd.feed_group`` stage records the wall spent
+    BLOCKED ON THE FEED (generator advance: cache read + h2d dispatch,
+    i.e. the part the async prefetch and the fold overlap hide) with the
+    cache-fed h2d bytes attributed — the measured counterpart of the
+    plan's ``bwd.feed_group`` stage prediction, refit by
+    `plan.autotune` like any other stage. Counters: ``bwd.feed_groups``
+    (feeds run) and ``bwd.feed_passes`` (passes served).
+
+    :param forward: a `StreamedForward` (or `mesh.MeshStreamedForward`)
+    :param subgrid_configs: the cover every pass consumes
+    :param backwards: the `StreamedBackward` passes sharing this feed
+    :param spill: the shared `utils.spill.SpillCache` (pass 1 of the
+        whole schedule records it; later feeds replay from it)
+    :param progress: optional callable(n_subgrids_folded) — heartbeat
+    :returns: number of column groups fed
+    """
+    backwards = list(backwards)
+    if not backwards:
+        return 0
+    cached = spill is not None and getattr(spill, "complete", False)
+    n_groups = 0
+    feed_wall = 0.0
+    feed_bytes = 0
+    with _trace.span(
+        "bwd.feed_group", cat="bwd", n_passes=len(backwards)
+    ):
+        gen = forward.stream_column_groups(subgrid_configs, spill=spill)
+        while True:
+            t0 = time.monotonic()
+            try:
+                per_col, group = next(gen)
+            except StopIteration:
+                break
+            feed_wall += time.monotonic() - t0
+            if cached:
+                feed_bytes += int(getattr(group, "nbytes", 0))
+            n_groups += 1
+            cols = [[sg for _, sg in col] for col in per_col]
+            for bwd in backwards:
+                bwd.add_subgrid_group(cols, group)
+            if progress is not None:
+                progress(sum(len(c) for c in cols) * len(backwards))
+    if _metrics.enabled():
+        _metrics.count("bwd.feed_groups")
+        _metrics.count("bwd.feed_passes", len(backwards))
+        _metrics.observe(
+            "bwd.feed_group", feed_wall, bytes_moved=feed_bytes
+        )
+    return n_groups
+
+
+# ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
 
@@ -3637,7 +3869,14 @@ class StreamedBackward:
             if base.mesh is not None:
                 foldfn = _bwd_sampled_fold_sharded(core, base.mesh)
             else:
-                foldfn = _bwd_sampled_fold_j(core)
+                from ..ops.pallas_kernels import pallas_interpret
+
+                kernel = resolve_fold_kernel(core)
+                foldfn = _bwd_sampled_fold_j(
+                    core, kernel == "pallas", pallas_interpret()
+                )
+                if kernel == "pallas" and _metrics.enabled():
+                    _metrics.count("bwd.pallas_folds")
             fold_flops = 0
             if _metrics.enabled():
                 from ..utils.flops import bwd_fold_flops

@@ -5,7 +5,10 @@ priced independently (ROADMAP item 4):
 
 * the backward facet x output-row-slab pass grid
   (`plan_backward_passes` — moved here verbatim from bench.py; bench
-  now delegates, and the 4k/32k/64k/128k golden tests pin equality);
+  now delegates, and the 4k/32k/64k/128k golden tests pin equality)
+  plus its feed-once/fold-many schedule (`plan_backward_feed`: how
+  many passes share each pass over the subgrid stream under the HBM
+  budget — the grid is grouped, never changed);
 * the spill policy (RAM ring / disk backing / forward replay) for the
   subgrid stream every backward pass consumes;
 * the serve batch shapes (power-of-two buckets under the coalescing
@@ -52,6 +55,7 @@ __all__ = [
     "ServePlan",
     "SpillPolicy",
     "compile_plan",
+    "plan_backward_feed",
     "plan_backward_passes",
     "plan_mesh_layout",
 ]
@@ -136,6 +140,43 @@ def plan_backward_passes(
     return parts, int(resident)
 
 
+def plan_backward_feed(
+    parts, resident_per_pass, budget,
+    fwd_min=DEFAULT_FWD_MIN_BYTES, reserve=DEFAULT_RESERVE_BYTES,
+    feed_env=0,
+):
+    """Passes-per-feed for the feed-once/fold-many backward schedule.
+
+    ``q`` passes sharing one feed keep ``q`` image accumulators (and
+    their fold-row pipelines) resident at once next to the feed's
+    working set, and in exchange the subgrid stream crosses the wire
+    once per FEED instead of once per pass
+    (`parallel.streamed.feed_backward_passes`) — with P passes the h2d
+    traffic drops from P× to ceil(P/q)× the stream. So q is simply the
+    largest pass count whose summed residency fits the per-pass HBM
+    budget the pass grid itself was sized against
+    (``budget − fwd_min − reserve``); the grid (`plan_backward_passes`)
+    is unchanged — n_passes semantics are preserved, the schedule only
+    groups the passes.
+
+    :param resident_per_pass: the grid's largest per-pass residency
+        (`plan_backward_passes`' second return)
+    :param feed_env: operator override (bench's BENCH_BWD_FEED_GROUP)
+    :returns: passes per feed, in [1, len(parts)]
+    """
+    n_passes = len(parts)
+    if feed_env:
+        return max(1, min(int(feed_env), n_passes))
+    if n_passes <= 1:
+        return 1
+    if budget is None:
+        return n_passes  # unlimited (CPU): one feed serves every pass
+    usable = budget - fwd_min - reserve
+    if resident_per_pass <= 0:
+        return n_passes
+    return max(1, min(int(usable // resident_per_pass), n_passes))
+
+
 # ---------------------------------------------------------------------------
 # Plan components
 # ---------------------------------------------------------------------------
@@ -146,6 +187,7 @@ class BackwardPlan:
     parts: list
     fold_group: int
     resident_bytes: int
+    feed_group: int = 1  # passes sharing one stream feed
 
     @property
     def n_passes(self):
@@ -159,12 +201,24 @@ class BackwardPlan:
     def n_row_slabs(self):
         return len({(p[2], p[3]) for p in self.parts})
 
+    @property
+    def n_feeds(self):
+        return -(-self.n_passes // max(1, self.feed_group))
+
+    def feed_chunks(self):
+        """The pass list chunked by the feed schedule: each chunk is
+        the group of parts one `feed_backward_passes` call serves."""
+        q = max(1, self.feed_group)
+        return [self.parts[i : i + q] for i in range(0, len(self.parts), q)]
+
     def as_dict(self):
         return {
             "n_passes": self.n_passes,
             "n_facet_passes": self.n_facet_passes,
             "n_row_slabs": self.n_row_slabs,
             "fold_group": self.fold_group,
+            "feed_group": self.feed_group,
+            "n_feeds": self.n_feeds,
             "resident_bytes": int(self.resident_bytes),
         }
 
@@ -377,6 +431,7 @@ class Plan:
             f"{self.backward.n_row_slabs} row slab(s), "
             f"fold_group={self.backward.fold_group}, "
             f"resident {self.backward.resident_bytes / gib:.2f} GiB",
+            self._explain_feed(),
             f"  spill: {self.spill.mode} "
             f"(stream {self.spill.stream_bytes / gib:.2f} GiB, "
             f"budget {self.spill.budget_bytes / gib:.2f} GiB)",
@@ -413,13 +468,54 @@ class Plan:
             for alt in self.alternatives:
                 if alt.get("chosen"):
                     continue
+                if alt.get("schedule"):
+                    lines.append(
+                        f"    schedule={alt['schedule']}: "
+                        f"{alt['n_feeds']} feed(s) of "
+                        f"{alt['feed_group']} pass(es), "
+                        f"predicted {alt['predicted_wall_s']:.1f} s"
+                    )
+                    continue
                 lines.append(
                     f"    fold_group={alt['fold_group']}: "
                     f"{alt['n_passes']} passes "
-                    f"({alt['n_facet_passes']}x{alt['n_row_slabs']}), "
-                    f"predicted {alt['predicted_wall_s']:.1f} s"
+                    f"({alt['n_facet_passes']}x{alt['n_row_slabs']}"
+                    + (
+                        f", {alt['n_feeds']} feed(s)"
+                        if "n_feeds" in alt
+                        else ""
+                    )
+                    + f"), predicted {alt['predicted_wall_s']:.1f} s"
                 )
         return "\n".join(lines)
+
+    def _explain_feed(self):
+        """The feed-once/fold-many schedule line: passes-per-feed, h2d
+        bytes the shared feed removes vs per-pass feeding, and whether
+        the fold compute is predicted to hide the feed (overlap)."""
+        gib = 2.0 ** 30
+        bwd = self.backward
+        saved = (bwd.n_passes - bwd.n_feeds) * self.inputs.stream_bytes
+        line = (
+            f"  feed schedule: {bwd.n_feeds} feed(s) x "
+            f"{bwd.feed_group} pass(es)/feed "
+            f"(h2d saved vs per-pass feeding: {saved / gib:.2f} GiB)"
+        )
+        stages = self.predicted.get("stages") or {}
+        feed = (stages.get("bwd.feed_group") or {}).get("wall_s")
+        fold = (stages.get("bwd.sampled_fold") or {}).get("wall_s")
+        if feed and fold:
+            if fold >= feed:
+                line += (
+                    f" — overlap: fold compute ({fold:.1f} s) is "
+                    f"predicted to hide the feed ({feed:.1f} s)"
+                )
+            else:
+                line += (
+                    f" — overlap: feed-bound ({feed:.1f} s feed vs "
+                    f"{fold:.1f} s fold)"
+                )
+        return line
 
 
 # ---------------------------------------------------------------------------
@@ -428,13 +524,16 @@ class Plan:
 
 
 def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
-             fwd_min, reserve, mesh=None):
+             fwd_min, reserve, mesh=None, feed_group=1):
     """Predicted per-stage walls + totals for one candidate plan.
 
     With a multi-shard ``mesh`` the prediction prices PER-SHARD HBM
     (facet stack, backward accumulator and row pipeline all shard over
     the facet axis) and adds the ICI collective stage (`mesh.psum`,
-    priced by bytes — the layout's ring all-reduce total).
+    priced by bytes — the layout's ring all-reduce total). Under the
+    feed-once/fold-many schedule the HBM peak carries ``feed_group``
+    shared pass residencies, and the feed traffic prices once per feed
+    (`price_backward`'s ``bwd.feed_group`` stage).
     """
     shards = mesh.facet_shards if mesh is not None else 1
     stages = []
@@ -442,7 +541,8 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
         stages += price_forward(inputs, coeffs)
     if mode == "roundtrip-streamed":
         stages += price_backward(
-            inputs, parts, fold_group, coeffs, spill_fed=use_spill
+            inputs, parts, fold_group, coeffs, spill_fed=use_spill,
+            feed_group=feed_group,
         )
     if mesh is not None and shards > 1 and mesh.collective_bytes_total:
         stages.append(
@@ -460,7 +560,8 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
         for i0, i1, r0, r1 in parts
     ) if mode == "roundtrip-streamed" else 0
     if mode == "roundtrip-streamed":
-        peak = resident / shards + fwd_min + reserve
+        q = min(max(1, feed_group), len(parts))
+        peak = q * resident / shards + fwd_min + reserve
     else:
         peak = inputs.facet_stack_bytes / shards + 3e9
     if inputs.hbm_budget:
@@ -519,7 +620,7 @@ def compile_plan(
     inputs, history=None, coeffs=None, mode="roundtrip-streamed",
     fwd_min=DEFAULT_FWD_MIN_BYTES, reserve=DEFAULT_RESERVE_BYTES,
     n_facet_env=0, n_row_env=0, allow_spill=True,
-    spill_budget=None, spill_dir=None,
+    spill_budget=None, spill_dir=None, feed_env=0,
 ):
     """Search the cost model; emit one `Plan`.
 
@@ -534,6 +635,9 @@ def compile_plan(
     :param allow_spill: False forces the replay cost model (BENCH_SPILL=0)
     :param spill_budget / spill_dir: spill-policy overrides; defaults
         are `utils.spill.spill_budget_bytes()` and SWIFTLY_SPILL_DIR
+    :param feed_env: operator passes-per-feed override for the
+        feed-once/fold-many schedule (bench's BENCH_BWD_FEED_GROUP;
+        0 = let `plan_backward_feed` size it from the budget)
     """
     if coeffs is None:
         if history:
@@ -562,8 +666,18 @@ def compile_plan(
     if spill_dir is None:
         spill_dir = os.environ.get("SWIFTLY_SPILL_DIR") or None
 
-    def _spill_mode(parts):
-        if not (allow_spill and len(parts) > 1):
+    def _feed(parts, resident):
+        return plan_backward_feed(
+            parts, resident, inputs.hbm_budget,
+            fwd_min=fwd_min, reserve=reserve, feed_env=feed_env,
+        )
+
+    def _spill_mode(parts, feed_group=1):
+        # the cache exists to serve feeds AFTER the first; a schedule
+        # whose single feed serves every pass never re-reads the stream,
+        # so recording it would be pure d2h overhead
+        n_feeds = -(-len(parts) // max(1, feed_group))
+        if not (allow_spill and n_feeds > 1):
             return "none"
         if inputs.stream_bytes <= spill_budget:
             return "ram"
@@ -588,40 +702,72 @@ def compile_plan(
     best = None
     for fg in candidates:
         parts_c, resident_c = _passes(fg)
-        use_spill_c = _spill_mode(parts_c) in ("ram", "disk")
+        feed_c = _feed(parts_c, resident_c)
+        use_spill_c = _spill_mode(parts_c, feed_c) in ("ram", "disk")
         pred_c = _predict(inputs, parts_c, fg, coeffs, mode,
-                          use_spill_c, fwd_min, reserve, mesh=mesh)
+                          use_spill_c, fwd_min, reserve, mesh=mesh,
+                          feed_group=feed_c)
         alt = {
             "fold_group": fg,
             "n_passes": len(parts_c),
             "n_facet_passes": len({(p[0], p[1]) for p in parts_c}),
             "n_row_slabs": len({(p[2], p[3]) for p in parts_c}),
+            "feed_group": feed_c,
+            "n_feeds": -(-len(parts_c) // feed_c),
             "predicted_wall_s": pred_c["wall_s"],
             "chosen": False,
         }
         alternatives.append(alt)
-        cand = (pred_c["wall_s"], fg, parts_c, resident_c, pred_c, alt)
+        cand = (
+            pred_c["wall_s"], fg, parts_c, resident_c, feed_c, pred_c,
+            alt,
+        )
         if best is None or cand[0] < best[0]:
             best = cand
     if coeffs.source == "measured" and mode == "roundtrip-streamed":
-        _wall, fold_group, parts, resident, predicted, chosen_alt = best
+        (_wall, fold_group, parts, resident, feed_group, predicted,
+         chosen_alt) = best
     else:
         # default coefficients: keep the seed heuristic's fold group —
         # equivalence first, the model only ranks
         fold_group = inputs.fold_group
         parts, resident = _passes(fold_group)
+        feed_group = _feed(parts, resident)
         predicted = _predict(
             inputs, parts, fold_group, coeffs, mode,
-            _spill_mode(parts) in ("ram", "disk"), fwd_min, reserve,
-            mesh=mesh,
+            _spill_mode(parts, feed_group) in ("ram", "disk"),
+            fwd_min, reserve, mesh=mesh, feed_group=feed_group,
         )
         chosen_alt = next(
             a for a in alternatives if a["fold_group"] == fold_group
         )
     chosen_alt["chosen"] = True
 
+    # the fused-schedule alternative: the same grid fed once per pass
+    # (the pre-feed-once cost model), recorded so plan_explain can show
+    # what the shared feed buys
+    if mode == "roundtrip-streamed" and len(parts) > 1:
+        pred_pp = _predict(
+            inputs, parts, fold_group, coeffs, mode,
+            _spill_mode(parts, 1) in ("ram", "disk"), fwd_min,
+            reserve, mesh=mesh, feed_group=1,
+        )
+        alternatives.append(
+            {
+                "schedule": "per_pass_feed",
+                "fold_group": fold_group,
+                "n_passes": len(parts),
+                "n_facet_passes": len({(p[0], p[1]) for p in parts}),
+                "n_row_slabs": len({(p[2], p[3]) for p in parts}),
+                "feed_group": 1,
+                "n_feeds": len(parts),
+                "predicted_wall_s": pred_pp["wall_s"],
+                "chosen": feed_group == 1,
+            }
+        )
+
     # -- spill policy --------------------------------------------------------
-    spill_mode = _spill_mode(parts)
+    spill_mode = _spill_mode(parts, feed_group)
     use_spill = spill_mode in ("ram", "disk")
     spill = SpillPolicy(
         use_spill=use_spill, mode=spill_mode,
@@ -641,7 +787,7 @@ def compile_plan(
     return Plan(
         inputs=inputs,
         mode=mode,
-        backward=BackwardPlan(parts, fold_group, resident),
+        backward=BackwardPlan(parts, fold_group, resident, feed_group),
         spill=spill,
         serve=serve,
         mesh=mesh,

@@ -361,6 +361,12 @@ _DEFAULT_BYTES_PER_S = {
     "spill.h2d": 6e9,
     "spill.write": 3e9,
     "spill.read": 6e9,
+    # the feed-once/fold-many stage: wall BLOCKED on the shared feed
+    # (cache read + h2d dispatch, after the async prefetch and the fold
+    # overlap hide what they can) per cache-fed byte. Defaults to the
+    # wire rate; the measured stage the executor records under the same
+    # name refits it to the post-overlap effective rate
+    "bwd.feed_group": 6e9,
     # per-link ICI ring bandwidth anchor (v5e ~45 GB/s effective);
     # coarse like every default — it ranks mesh plans, it is not a
     # contract (measured coefficients refit it like any other stage)
@@ -443,13 +449,20 @@ def price_forward(inputs, coeffs, colpass=None):
 
 
 def price_backward(inputs, parts, fold_group, coeffs,
-                   spill_fed=True, colpass=None):
+                   spill_fed=True, colpass=None, feed_group=1):
     """Stage costs of a facet x row-slab partitioned sampled backward.
 
-    Every pass consumes the whole subgrid stream; with ``spill_fed``
-    passes after the first read it back host->device instead of
-    replaying the forward (`utils.spill`). Fold FLOPs restrict with the
-    pass's output-row slab (the "ri" index restriction is free).
+    Every pass consumes the whole subgrid stream — but under the
+    feed-once/fold-many schedule ``feed_group`` passes SHARE each feed
+    (`parallel.streamed.feed_backward_passes`), so the stream crosses
+    the wire once per FEED, not once per pass. With ``spill_fed`` the
+    feeds after the first read the recorded stream back host->device
+    (the ``bwd.feed_group`` stage, priced by bytes); without a usable
+    cache each later feed replays the forward instead — still once per
+    feed, the schedule helps the replay model identically. Fold FLOPs
+    restrict with the pass's output-row slab (the "ri" index
+    restriction is free). ``feed_group=1`` reproduces the pre-schedule
+    per-pass-feed pricing exactly.
     """
     from ..utils.flops import (
         bwd_column_pass_flops,
@@ -473,6 +486,7 @@ def price_backward(inputs, parts, fold_group, coeffs,
             * (r1 - r0) / inputs.yB
         )
     n_passes = len(parts)
+    n_feeds = -(-n_passes // max(1, int(feed_group)))
     folds_per_pass = -(-inputs.n_columns // max(1, fold_group))
     stages = [
         coeffs.price("bwd.column_pass", flops=col_flops,
@@ -480,26 +494,27 @@ def price_backward(inputs, parts, fold_group, coeffs,
         coeffs.price("bwd.sampled_fold", flops=fold_flops,
                      dispatches=n_passes * folds_per_pass),
     ]
-    if spill_fed and n_passes > 1:
+    if spill_fed and n_feeds > 1:
         stages.append(
             coeffs.price("spill.write",
                          bytes_moved=inputs.stream_bytes)
         )
         stages.append(
-            coeffs.price("spill.h2d",
-                         bytes_moved=(n_passes - 1) * inputs.stream_bytes)
+            coeffs.price("bwd.feed_group",
+                         bytes_moved=(n_feeds - 1) * inputs.stream_bytes,
+                         dispatches=n_feeds - 1)
         )
-    elif n_passes > 1:
-        # replay cost model: passes 2..P re-run the forward (aggregated
-        # into one stage — the per-pass split adds nothing)
+    elif n_feeds > 1:
+        # replay cost model: feeds 2..n re-run the forward (aggregated
+        # into one stage — the per-feed split adds nothing)
         replays = price_forward(inputs, coeffs)
         stages.append(
             StageCost(
                 "fwd.replay",
-                (n_passes - 1) * sum(s.flops for s in replays),
-                (n_passes - 1) * sum(s.bytes_moved for s in replays),
-                (n_passes - 1) * sum(s.dispatches for s in replays),
-                (n_passes - 1) * sum(s.wall_s for s in replays),
+                (n_feeds - 1) * sum(s.flops for s in replays),
+                (n_feeds - 1) * sum(s.bytes_moved for s in replays),
+                (n_feeds - 1) * sum(s.dispatches for s in replays),
+                (n_feeds - 1) * sum(s.wall_s for s in replays),
             )
         )
     return stages

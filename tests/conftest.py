@@ -25,3 +25,28 @@ import jax  # noqa: E402
 # override via config (backends initialise lazily, so this is still in time).
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def unusable_donation_warnings(fn, *args, **kwargs):
+    """Run ``fn`` under warning capture; return the "Some donated
+    buffers were not usable" warnings it raised.
+
+    The shared backward-path donation guard (ROADMAP item 2): a
+    dangling donation means XLA silently copies a multi-GiB buffer on
+    every dispatch. PR 2 fixed the `_column_group_finish_j` instance
+    and the PR-7 sweep found no survivors; lowering the donated
+    programs under this capture (XLA's input-output alias analysis
+    emits the warning at compile time, CPU included) keeps it that way
+    — callers assert the returned list is empty. ``fn`` is typically
+    ``jitted.lower(*args).compile`` bound via a lambda, or any call
+    that traces + compiles the program under test.
+    """
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fn(*args, **kwargs)
+    return [
+        w for w in caught
+        if "donated buffers were not usable" in str(w.message).lower()
+    ]

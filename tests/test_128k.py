@@ -284,6 +284,28 @@ def test_compile_plan_golden_seed_grids():
             plan.backward.n_facet_passes, plan.backward.n_row_slabs
         ) == (want_f, want_r), name
         assert plan.backward.fold_group == 2, name  # seed choice kept
+        # the feed-once/fold-many schedule GROUPS the seed grid, never
+        # changes it: n_passes semantics preserved, q in [1, P] with
+        # ceil-coherent feed count, and the shared residency stays
+        # inside the per-pass budget the grid was sized against
+        bwd = plan.backward
+        assert bwd.n_passes == len(parts), name
+        assert 1 <= bwd.feed_group <= bwd.n_passes, name
+        assert bwd.n_feeds == -(-bwd.n_passes // bwd.feed_group), name
+        assert sum(len(c) for c in bwd.feed_chunks()) == bwd.n_passes
+        if bwd.n_passes > 1:
+            assert (
+                bwd.feed_group * resident
+                <= budget - fwd_min - reserve
+            ), name
+            # forcing per-pass feeding reproduces the pre-schedule shape
+            pp = compile_plan(
+                PlanInputs.from_config(name, hbm_budget=budget),
+                fwd_min=fwd_min, reserve=reserve, feed_env=1,
+            )
+            assert pp.backward.parts == parts, name
+            assert pp.backward.feed_group == 1, name
+            assert pp.backward.n_feeds == len(parts), name
         # unlimited budget (CPU): one whole pass, no spill
         cpu = compile_plan(PlanInputs.from_config(name))
         assert cpu.backward.parts == [(0, F_total, 0, yB)], name

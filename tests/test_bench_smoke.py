@@ -80,6 +80,22 @@ def test_bench_smoke_leg(tmp_path):
     assert {"spill.write", "spill.read", "spill.h2d"} <= set(stages)
     assert record["bwd_plan"]["n_passes"] == 2
 
+    # feed-once/fold-many schedule: the smoke pins per-pass feeding
+    # (BENCH_BWD_FEED_GROUP=1 — CPU's unlimited budget would otherwise
+    # share one feed and never touch the cache), the compiled plan
+    # carries the schedule, the executed feeds match it, and the h2d
+    # byte collapse is exactly (n_feeds - 1) x the recorded stream
+    bwd_plan = record["bwd_plan"]
+    assert bwd_plan["feed_group"] == 1 and bwd_plan["n_feeds"] == 2
+    pc_bwd = record["plan_compiled"]["backward"]
+    assert pc_bwd["feed_group"] == 1 and pc_bwd["n_feeds"] == 2
+    assert record["feed_groups"] == 2
+    assert "bwd.feed_group" in stages
+    stream_bytes = spill["ram_bytes"] + spill["disk_bytes"]
+    assert record["spill_h2d_bytes"] == (
+        (bwd_plan["n_feeds"] - 1) * stream_bytes
+    )
+
     names = {
         r["name"]
         for r in map(json.loads, jsonl.read_text().splitlines())
@@ -130,6 +146,17 @@ def test_bench_smoke_leg(tmp_path):
     # doctored 2x-faster baseline → the sentinel must trip non-zero
     doctored = dict(record)
     doctored["value"] = record["value"] / 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 1
+    # the round-trip MFU sentinel (higher is better): a doctored
+    # 2x-higher-MFU reference — wall UNCHANGED, isolating the MFU leg —
+    # must trip exactly like the mesh scaling sentinel, locking in the
+    # 5.5% -> target climb of the backward-path recovery
+    assert record["mfu_pct"] > 0
+    doctored = dict(record)
+    doctored["mfu_pct"] = record["mfu_pct"] * 2.0
     ref.write_text(json.dumps(doctored))
     assert compare_main(
         [str(out), "--against", str(ref), "--json"]

@@ -271,10 +271,12 @@ def test_fused_serve_batch_lowers_without_unusable_donations(cover):
     """ROADMAP item 2's "unusable donation" warnings: PR 2 fixed the
     `_column_group_finish_j` instance, and a sweep found no survivors
     in the fused serve batch path — this guard keeps it that way by
-    lowering a fused multi-column batch under warning capture. A
-    reappearing `Some donated buffers were not usable` means a new
-    dangling donation (a silent HBM copy on every dispatch)."""
-    import warnings
+    lowering a fused multi-column batch under warning capture (the
+    shared `conftest.unusable_donation_warnings` guard; its backward-
+    path twin lives in tests/test_spill.py). A reappearing `Some
+    donated buffers were not usable` means a new dangling donation (a
+    silent HBM copy on every dispatch)."""
+    from conftest import unusable_donation_warnings
 
     config, _tasks, sgs = cover
     cols = sorted({sg.off0 for sg in sgs})
@@ -283,13 +285,11 @@ def test_fused_serve_batch_lowers_without_unusable_donations(cover):
         _forward(cover), fuse_columns=2,
         scheduler=CoalescingScheduler(max_batch=16),
     )
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        reqs = svc.serve(workload)
+    reqs = []
+    donation = unusable_donation_warnings(
+        lambda: reqs.extend(svc.serve(workload))
+    )
     _assert_all_ok(reqs)
-    donation = [
-        w for w in caught if "donated" in str(w.message).lower()
-    ]
     assert not donation, [str(w.message) for w in donation]
 
 

@@ -165,13 +165,19 @@ def test_cache_fed_backward_bitidentical_to_replay(backend):
     assert counters.get("spill.fallback_replays", 0) == 0
 
 
-def test_cache_disk_backed_feed_matches(tmp_path):
-    """A cache whose budget forces every entry to disk feeds the same
-    stream (exercises the chunked memmap write + full read path)."""
+def test_cache_disk_backed_feed_matches_without_prefetch(tmp_path,
+                                                         monkeypatch):
+    """A cache whose budget forces every entry to disk, read back with
+    the background prefetch thread DISABLED (SWIFTLY_SPILL_PREFETCH=0,
+    inline reads), feeds a bit-identical stream — the chunked memmap
+    write + full read path AND the overlap being a pure scheduling
+    change, in one pair of runs. (The prefetch-ON disk read path runs
+    in every other cache-fed test via the default.)"""
     config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
     ref = _run_partitioned_backward(
         config, facet_configs, subgrid_configs, facet_tasks, spill=None
     )
+    monkeypatch.setenv("SWIFTLY_SPILL_PREFETCH", "0")
     out = _run_partitioned_backward(
         config, facet_configs, subgrid_configs, facet_tasks,
         spill=SpillCache(budget_bytes=1, spill_dir=str(tmp_path)),
@@ -204,3 +210,174 @@ def test_spill_eviction_falls_back_to_replay():
     assert counters["spill.fallback_replays"] == 1  # pass 2 skipped fill
     assert counters["spill.evictions"] >= 1
     assert "spill.replay_feeds" not in counters
+
+
+# ---------------------------------------------------------------------------
+# Feed-once/fold-many scheduling
+# ---------------------------------------------------------------------------
+
+
+def _run_feed_scheduled_backward(config, facet_configs, subgrid_configs,
+                                 facet_tasks, spill, feed_group):
+    """Per-facet passes (one per facet) run under the feed-once/fold-
+    many schedule: `feed_group` passes share each stream feed."""
+    from swiftly_tpu.parallel import feed_backward_passes
+
+    fwd = StreamedForward(config, facet_tasks, residency="device",
+                          col_group=4)
+    outs = []
+    for c0 in range(0, len(facet_configs), feed_group):
+        chunk = facet_configs[c0 : c0 + feed_group]
+        bwds = [
+            StreamedBackward(config, [fc], residency="sampled")
+            for fc in chunk
+        ]
+        feed_backward_passes(fwd, subgrid_configs, bwds, spill=spill)
+        outs.extend(bwd.finish() for bwd in bwds)
+    return np.concatenate(outs)
+
+
+def test_feed_once_fold_many_bitidentical_and_h2d_collapse():
+    """The feed-once/fold-many tentpole pin: P per-facet passes fed in
+    shared feeds of q produce BIT-IDENTICAL facets to per-pass feeding,
+    run exactly ONE forward, and move exactly (n_feeds - 1) x stream
+    bytes host->device where per-pass feeding moves (P - 1) x — the
+    (P-1)x h2d collapse asserted from telemetry, not inferred."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    P = len(facet_configs)
+    assert P >= 3  # the schedule needs a non-trivial pass count
+
+    def run(feed_group):
+        metrics.reset()
+        metrics.enable()
+        try:
+            spill = SpillCache(budget_bytes=1e9)
+            out = _run_feed_scheduled_backward(
+                config, facet_configs, subgrid_configs, facet_tasks,
+                spill, feed_group,
+            )
+            exp = metrics.export()
+        finally:
+            metrics.disable()
+            metrics.reset()
+        stream = spill.ram_bytes + spill.disk_bytes
+        h2d = (exp["stages"].get("spill.h2d") or {}).get("bytes", 0)
+        return out, exp["counters"], stream, h2d
+
+    ref, c_pp, stream_pp, h2d_pp = run(feed_group=1)  # per-pass feeding
+    out, c_f, stream_f, h2d_f = run(feed_group=2)     # shared feeds
+
+    np.testing.assert_array_equal(out, ref)  # bit-identical facets
+    assert c_pp["fwd.passes"] == 1 and c_f["fwd.passes"] == 1
+    assert stream_pp == stream_f > 0
+    n_feeds = -(-P // 2)
+    assert c_f["bwd.feed_groups"] == n_feeds
+    assert c_f["bwd.feed_passes"] == P
+    # the h2d byte collapse: per-pass moved (P-1) x stream, the shared
+    # schedule (n_feeds - 1) x
+    assert h2d_pp == (P - 1) * stream_pp
+    assert h2d_f == (n_feeds - 1) * stream_f
+    assert h2d_f < h2d_pp
+
+
+def test_feed_schedule_replay_fallback_shares_forwards():
+    """Without a usable cache the schedule still helps: q passes share
+    each forward REPLAY, so P per-facet passes in feeds of 2 cost
+    ceil(P/2) forwards instead of P — and the facets are identical to
+    one all-passes-in-one-feed run (1 forward, same fold order per
+    pass — every pass folds the same stream whatever the grouping)."""
+    config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
+    P = len(facet_configs)
+    metrics.reset()
+    metrics.enable()
+    try:
+        ref = _run_feed_scheduled_backward(
+            config, facet_configs, subgrid_configs, facet_tasks,
+            spill=None, feed_group=P,  # one shared feed: 1 forward
+        )
+        c1 = metrics.export()["counters"]
+        metrics.reset()
+        out = _run_feed_scheduled_backward(
+            config, facet_configs, subgrid_configs, facet_tasks,
+            spill=None, feed_group=2,
+        )
+        c2 = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    np.testing.assert_array_equal(out, ref)
+    assert c1["fwd.passes"] == 1
+    assert c2["fwd.passes"] == -(-P // 2)
+
+
+# ---------------------------------------------------------------------------
+# Backward-path donation guard (shared with tests/test_serve.py)
+# ---------------------------------------------------------------------------
+
+
+def test_backward_path_lowers_without_unusable_donations():
+    """The backward-path half of the donation sweep: every donated
+    backward jit (`_bwd_sampled_fold_j` einsum AND fused-Pallas bodies,
+    `_bwd_fft_fold_chunk_j`, `_bwd_ct_fold_j`) lowers without `Some
+    donated buffers were not usable` — a reappearing warning means a
+    silent accumulator copy on every fold dispatch (the serve-path
+    half guards the fused batch, tests/test_serve.py)."""
+    import jax.numpy as jnp
+
+    from conftest import unusable_donation_warnings
+    from swiftly_tpu.parallel.streamed import (
+        _bwd_ct_fold_j,
+        _bwd_fft_fold_chunk_j,
+        _bwd_sampled_fold_j,
+        _ct_fold_tables,
+        sampled_row_indices,
+    )
+
+    config = SwiftlyConfig(backend="planar", **TEST_PARAMS)
+    core = config.core
+    F, yB = 2, TEST_PARAMS["yB_size"]
+    m = core.xM_yN_size
+    offs = [0, TEST_PARAMS["xA_size"]]
+    krows = jnp.asarray(sampled_row_indices(core, offs))
+    R = len(offs) * m
+    dt = np.dtype(core.dtype)
+    acc = jnp.zeros((F, yB, yB, 2), dt)
+    rows = jnp.zeros((F, R, yB, 2), dt)
+    e0 = jnp.zeros(F, jnp.int32)
+    problems = {}
+
+    for label, fold in (
+        ("sampled_fold", _bwd_sampled_fold_j(core)),
+        ("sampled_fold_pallas", _bwd_sampled_fold_j(core, True, True)),
+    ):
+        bad = unusable_donation_warnings(
+            lambda fold=fold: fold.lower(
+                acc, rows, e0, krows, jnp.int32(0)
+            ).compile()
+        )
+        if bad:
+            problems[label] = [str(w.message) for w in bad]
+
+    rows_g = jnp.zeros((2, F, m, yB, 2), dt)
+    offs_dev = jnp.asarray(np.asarray(offs, np.int32))
+    foffs0 = jnp.zeros(F, dtype=int)
+    fftfold = _bwd_fft_fold_chunk_j(core, 128)
+    bad = unusable_donation_warnings(
+        lambda: fftfold.lower(
+            acc, rows_g, offs_dev, foffs0, jnp.int32(0), jnp.int32(0)
+        ).compile()
+    )
+    if bad:
+        problems["fft_fold"] = [str(w.message) for w in bad]
+
+    Q, Pq, kmax, r_idx, a_vals = _ct_fold_tables(core, offs)
+    ctfold = _bwd_ct_fold_j(core, Q, Pq, kmax, yB)
+    bad = unusable_donation_warnings(
+        lambda: ctfold.lower(
+            acc, rows, e0, krows, jnp.asarray(r_idx),
+            jnp.asarray(a_vals), jnp.int32(0),
+        ).compile()
+    )
+    if bad:
+        problems["ct_fold"] = [str(w.message) for w in bad]
+    assert not problems, problems

@@ -343,8 +343,23 @@ def test_forward_rejects_sampled_residency():
 
 
 @pytest.mark.parametrize("backend", ["jax", "planar"])
-@pytest.mark.parametrize("fold_group", [1, 3])
-@pytest.mark.parametrize("fold_mode", ["sampled", "fft", "ct"])
+@pytest.mark.parametrize(
+    "fold_group,fold_mode",
+    [
+        (1, "sampled"),
+        (3, "sampled"),
+        (1, "fft"),
+        (1, "ct"),
+        # the fold_group axis for the NON-default bodies is -m slow
+        # (tier-1 brushes the driver window): batching more columns per
+        # fold is the same code path at a different static shape, the
+        # default sampled body keeps both group sizes in tier-1, and
+        # the grouped fft/ct feed paths are exercised by the
+        # add_subgrid_group chunking tests
+        pytest.param(3, "fft", marks=pytest.mark.slow),
+        pytest.param(3, "ct", marks=pytest.mark.slow),
+    ],
+)
 def test_sampled_backward_matches_fft_backward(
     backend, fold_group, fold_mode, monkeypatch
 ):
@@ -456,9 +471,17 @@ def test_row_slab_backward_matches_whole_facet():
     )
 
 
+@pytest.mark.slow
 def test_row_slab_composes_with_facet_partition():
     """Facet subsets x row slabs (the full 128k partition grid) tile the
-    whole-facet backward exactly."""
+    whole-facet backward exactly.
+
+    ``-m slow``-gated (tier-1 brushes the driver window): each axis is
+    pinned separately in tier-1 (`test_row_slab_backward_matches_whole_
+    facet`, `test_facet_partitioned_sampled_backward_matches_full`),
+    the feed-once/fold-many schedule tests in tests/test_spill.py pin
+    multi-pass composition bit-identically, and the 128k dryrun proxy
+    (tests/test_128k.py) exercises the composed grid at true geometry."""
     config, facet_configs, subgrid_configs, facet_tasks = _setup("planar")
     fwd = StreamedForward(config, facet_tasks, residency="device")
     subgrids = fwd.all_subgrids(subgrid_configs)
