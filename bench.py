@@ -2386,6 +2386,506 @@ def mesh_bench(smoke_mode=False):
     return 0 if not problems else 1
 
 
+def _delta_mutate(tasks, idxs, scale):
+    """A content-bearing mutation of the facets at ``idxs``: scale the
+    sparse descriptor's pixel values (a sky-model amplitude change —
+    the K-of-J update the incremental engine exists for)."""
+    from swiftly_tpu.ops.oracle import SparseRealFacet
+
+    out = list(tasks)
+    for i in idxs:
+        fc, f = tasks[i]
+        out[i] = (
+            fc,
+            SparseRealFacet(
+                f.size, f.rows, f.cols,
+                np.asarray(f.vals) * np.float32(scale),
+            ),
+        )
+    return out
+
+
+def delta_bench(smoke_mode=False):
+    """`bench.py --delta [--smoke]`: the incremental re-transform leg.
+
+    Records the full subgrid stream once (`delta.IncrementalForward`),
+    then mutates K of the J facets (BENCH_DELTA_K, default "1,3") and
+    times the incremental update — delta stream restricted to the K
+    changed facets, cached stream patched in place — against the timed
+    full re-record. Asserts: the engine took the PATCH path (its
+    `plan.plan_delta` pricing agrees), the patched stream matches a
+    fresh full recompute of the new stack within the documented f32
+    sum-reorder tolerance (BENCH_DELTA_TOL, default 1e-4 relative —
+    docs/incremental.md), and `SWIFTLY_DELTA_EXACT`-style updates
+    (``exact=True``) are BIT-identical to the fresh recompute. Stamps a
+    ``delta`` artifact block {changed_facets, patched_columns,
+    speedup_vs_full, max_abs_diff, plan, match, exact} validated by
+    `obs.validate_delta_artifact`; `scripts/delta_drill.py` is the
+    operator entry.
+    """
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_delta_artifact,
+    )
+
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    out_path = os.environ.get("BENCH_DELTA_OUT", "BENCH_delta.json")
+    metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+    name = os.environ.get(
+        "BENCH_DELTA_CONFIG",
+        "1k[1]-n512-256" if smoke_mode else "4k[1]-n2k-512",
+    )
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu import (
+        SWIFT_CONFIGS,
+        SwiftlyConfig,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+        make_sparse_facet,
+    )
+    from swiftly_tpu.delta import FacetDeltaLedger, IncrementalForward
+    from swiftly_tpu.parallel import StreamedForward
+    from swiftly_tpu.utils.spill import SpillCache
+
+    platform = jax.devices()[0].platform
+    problems = []
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    sources = _bench_sources(config.image_size)
+    facet_tasks = [
+        (fc, make_sparse_facet(config.image_size, fc, sources,
+                               dtype=np.float32))
+        for fc in facet_configs
+    ]
+    F = len(facet_configs)
+    # only content-bearing facets make a real delta (scaling an empty
+    # descriptor is content-identical and the ledger rightly ignores it)
+    content = [
+        j for j, (_, f) in enumerate(facet_tasks)
+        if np.asarray(f.vals).size
+    ]
+    if not content:
+        problems.append("no facet carries source pixels; nothing to mutate")
+    ks = sorted({
+        max(1, min(int(k), max(1, F - 1), len(content)))
+        for k in os.environ.get("BENCH_DELTA_K", "1,3").split(",")
+    })
+
+    from swiftly_tpu.utils.spill import spill_budget_bytes
+
+    spill = SpillCache(budget_bytes=spill_budget_bytes())
+    engine = IncrementalForward(
+        config, facet_tasks, spill, ledger=FacetDeltaLedger()
+    )
+    log.info("delta leg: warmup record (%s, %d facets)", name, F)
+    engine.record(subgrid_configs)  # compile + layout warmup
+    log.info("delta leg: timed full record")
+    t0 = time.time()
+    engine.record(subgrid_configs)
+    wall_full = time.time() - t0
+
+    def fresh_reference(tasks):
+        """A fresh full stream of ``tasks`` into its own cache — the
+        ground truth the patched stream is audited against."""
+        ref = SpillCache(budget_bytes=spill_budget_bytes())
+        rfwd = StreamedForward(config, tasks, residency="device")
+        for _ in rfwd.stream_column_groups(subgrid_configs, spill=ref):
+            pass
+        return ref
+
+    def audit(ref):
+        mx = sc = 0.0
+        for k in range(len(spill)):
+            a = np.asarray(spill.get(k))
+            b = np.asarray(ref.get(k))
+            mx = max(mx, float(np.max(np.abs(a - b))))
+            sc = max(sc, float(np.max(np.abs(b))))
+        return mx, sc or 1.0
+
+    legs = []
+    scale_step = 1.5
+    # under SWIFTLY_DELTA_EXACT=1 (delta_drill --exact) every update
+    # replays by contract, and the audit tightens to bit-identity
+    exact_env = os.environ.get("SWIFTLY_DELTA_EXACT") == "1"
+    for kk in ks:
+        idxs = content[:kk]
+        # warm update: compiles the K-facet delta pass (a fresh
+        # StreamedForward per update shares the lru-cached jits)
+        scale_step += 0.25
+        engine.update(_delta_mutate(engine.facet_tasks, idxs, scale_step))
+        scale_step += 0.25
+        tasks2 = _delta_mutate(engine.facet_tasks, idxs, scale_step)
+        t0 = time.time()
+        report = engine.update(tasks2)
+        wall_patch = time.time() - t0
+        if exact_env:
+            if report["mode"] != "replay":
+                problems.append(
+                    f"K={kk} exact-mode update took mode "
+                    f"{report['mode']!r}; SWIFTLY_DELTA_EXACT=1 must "
+                    "force the full replay"
+                )
+        elif report["mode"] != "patch":
+            problems.append(
+                f"K={kk} update took mode {report['mode']!r} "
+                f"(reason {report['reason']!r}); the drill must "
+                "exercise the patch path"
+            )
+        mx, sc = audit(fresh_reference(engine.facet_tasks))
+        tol = (
+            0.0
+            if exact_env
+            else float(os.environ.get("BENCH_DELTA_TOL", "1e-4")) * sc
+        )
+        if not mx <= tol:
+            problems.append(
+                f"K={kk} patched stream diverges from fresh recompute "
+                f"by {mx:.3e} (> f32 sum-reorder tolerance {tol:.3e})"
+            )
+        legs.append(
+            {
+                "k": kk,
+                "changed_facets": list(report["changed_facets"]),
+                "patched_columns": report["patched_columns"],
+                "patched_entries": report["patched_entries"],
+                "patch_wall_s": round(wall_patch, 4),
+                "full_wall_s": round(wall_full, 4),
+                "speedup_vs_full": round(wall_full / wall_patch, 2),
+                "match": {
+                    "max_abs_diff": mx,
+                    "tolerance": tol,
+                    "within_tolerance": bool(mx <= tol),
+                    "bit_identical": bool(mx == 0.0),
+                },
+                "stream_version": report["stream_version"],
+                "plan": report["plan"],
+            }
+        )
+        log.info(
+            "delta leg: K=%d patch %.3fs vs full %.3fs (%.1fx), "
+            "max|diff| %.3e", kk, wall_patch, wall_full,
+            wall_full / wall_patch, mx,
+        )
+
+    # exactness escape hatch: an exact update re-records and must be
+    # BIT-identical to an independent fresh stream of the same stack
+    exact_block = None
+    if os.environ.get("BENCH_DELTA_EXACT_CHECK", "1") == "1" and content:
+        tasks3 = _delta_mutate(engine.facet_tasks, content[:1], 0.8)
+        rep3 = engine.update(tasks3, exact=True)
+        ref3 = fresh_reference(engine.facet_tasks)
+        bit = all(
+            np.array_equal(
+                np.asarray(spill.get(k)), np.asarray(ref3.get(k))
+            )
+            for k in range(len(spill))
+        )
+        exact_block = {"mode": rep3["mode"], "bit_identical": bool(bit)}
+        if rep3["mode"] != "replay" or not bit:
+            problems.append(
+                f"exact update must replay bit-identically, got "
+                f"{exact_block}"
+            )
+
+    head = legs[0] if legs else {}
+    delta_block = {
+        "n_facets": F,
+        "changed_facets": head.get("changed_facets", []),
+        "patched_columns": head.get("patched_columns", 0),
+        "patched_entries": head.get("patched_entries", 0),
+        "speedup_vs_full": head.get("speedup_vs_full", 0.0),
+        "max_abs_diff": (head.get("match") or {}).get("max_abs_diff"),
+        "match": head.get("match"),
+        "plan": head.get("plan"),
+        "exact": exact_block,
+        "exact_mode": exact_env,
+        "legs": legs,
+        "spill": spill.stats(),
+    }
+    record = {
+        "metric": f"{name} incremental K-facet update wall-clock "
+                  f"({len(subgrid_configs)} subgrids, planar f32, "
+                  f"delta, {platform})",
+        "value": head.get("patch_wall_s", 0.0),
+        "unit": "s",
+        "n_subgrids": len(subgrid_configs),
+        "full_record_wall_s": round(wall_full, 4),
+        "delta": delta_block,
+    }
+    record["manifest"] = run_manifest(
+        baseline_source=None,
+        params={"config": name, "mode": "delta", **params},
+    )
+    record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+        from swiftly_tpu.obs import trace as otrace
+
+        record["trace"] = summarize_trace(otrace.export())
+        otrace.save(trace_path)
+        otrace.disable()
+    problems.extend(validate_delta_artifact(record))
+    import json as _json
+
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    metrics.disable()
+    print(
+        json.dumps(
+            {
+                "delta_smoke" if smoke_mode else "delta": (
+                    "ok" if not problems else "failed"
+                ),
+                "config": name,
+                "artifact": out_path,
+                "speedup_vs_full": delta_block["speedup_vs_full"],
+                "patched_columns": delta_block["patched_columns"],
+                "max_abs_diff": delta_block["max_abs_diff"],
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
+
+
+# Relative-RMS error budgets asserted by `bench.py --precision` — the
+# code twin of the table in docs/accuracy.md ("Precision error budget").
+# Relative RMS = abs RMS x N^2 (the unit-source scaling of accuracy.md;
+# the bench's multi-source model with amplitudes up to 2.75 and a
+# max-over-samples RMS measures ~2e-5 at the `highest` f32 floor).
+# Budgets carry ~15x headroom over the measured floor so they trip on a
+# real precision regression (`high`'s bf16x3 passes sit ~63x above the
+# floor on TPU; a LOST `highest` flag therefore lands near ~1.3e-3,
+# well past the 3e-4 budget) but never on run-to-run noise. On CPU both
+# settings execute true f32 matmuls and land at the `highest` floor.
+PRECISION_RMS_BUDGET_REL = {
+    "highest": 3e-4,
+    "high": 3e-2,
+    "default": 3e-2,
+}
+
+
+def precision_child():
+    """`bench.py --precision-child`: one precision setting, one process.
+
+    `SWIFTLY_PRECISION` is baked into the lowered programs at TRACE
+    time (ops.planar_backend), so each setting must run in its own
+    interpreter — the parent (`precision_bench`) sets the env and
+    spawns this, which streams the forward cover once warm + once
+    timed and prints a single JSON line with the wall and the
+    max-over-samples RMS vs the direct-DFT oracle.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+    name = os.environ.get("BENCH_PRECISION_CONFIG", "1k[1]-n512-256")
+    from swiftly_tpu import SWIFT_CONFIGS
+
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    config, fwd, facet_configs, subgrid_configs, sources = _build(
+        "planar", params, jnp.float32, streamed=True
+    )
+    sample_map, oracle_dev = _oracle_sample_stack(
+        config, subgrid_configs, sources
+    )
+
+    def run_pass():
+        max_rms2 = jnp.zeros((), dtype=jnp.float32)
+        acc = None
+        for items, out in fwd.stream_columns(
+            subgrid_configs, device_arrays=True
+        ):
+            s = jnp.sum(out)
+            acc = s if acc is None else acc + s
+            for srow, (i, _sgc) in enumerate(items):
+                k = sample_map.get(i)
+                if k is not None:
+                    max_rms2 = jnp.maximum(
+                        max_rms2,
+                        _rms2_device(config.core, out[srow], oracle_dev[k]),
+                    )
+        float(np.asarray(acc))
+        return float(np.asarray(max_rms2)) ** 0.5
+
+    run_pass()  # warm: compile + facet upload
+    t0 = time.time()
+    rms = run_pass()
+    wall = time.time() - t0
+    print(
+        json.dumps(
+            {
+                "precision": os.environ.get(
+                    "SWIFTLY_PRECISION", "highest"
+                ).lower(),
+                "config": name,
+                "wall_s": round(wall, 4),
+                "rms_vs_dft_oracle": float(f"{rms:.3e}"),
+                "n_subgrids": len(subgrid_configs),
+                "platform": jax.devices()[0].platform,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def precision_bench(smoke_mode=False):
+    """`bench.py --precision [--smoke]`: the mixed-precision leg.
+
+    Runs the streamed forward under each `SWIFTLY_PRECISION` setting
+    (BENCH_PRECISION_SETTINGS, default "highest,high") in a SUBPROCESS
+    each — the knob is baked in at trace time — and asserts every
+    measured RMS against the explicit error budget table
+    (`PRECISION_RMS_BUDGET_REL`, documented in docs/accuracy.md).
+    The artifact's headline wall and ``rms_vs_dft_oracle`` come from
+    the ``highest`` leg so `scripts/bench_compare.py` tracks both
+    (wall and RMS lower-is-better).
+    """
+    import subprocess
+
+    from swiftly_tpu.obs import run_manifest, validate_artifact
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    name = os.environ.get(
+        "BENCH_PRECISION_CONFIG",
+        "1k[1]-n512-256" if smoke_mode else "4k[1]-n2k-512",
+    )
+    out_path = os.environ.get(
+        "BENCH_PRECISION_OUT", "BENCH_precision.json"
+    )
+    settings = [
+        s.strip().lower()
+        for s in os.environ.get(
+            "BENCH_PRECISION_SETTINGS", "highest,high"
+        ).split(",")
+        if s.strip()
+    ]
+    from swiftly_tpu import SWIFT_CONFIGS
+
+    params = dict(SWIFT_CONFIGS[name])
+    n_img = params["N"]
+    problems = []
+    legs = []
+    for setting in settings:
+        budget_rel = PRECISION_RMS_BUDGET_REL.get(setting)
+        if budget_rel is None:
+            problems.append(
+                f"no error budget for SWIFTLY_PRECISION={setting!r} "
+                "(docs/accuracy.md table)"
+            )
+            continue
+        env = dict(os.environ)
+        env["SWIFTLY_PRECISION"] = setting
+        env["BENCH_PRECISION_CONFIG"] = name
+        log.info("precision leg: %s (subprocess)", setting)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--precision-child"],
+            capture_output=True, text=True, env=env,
+            timeout=float(os.environ.get("BENCH_PRECISION_TIMEOUT_S",
+                                         "600")),
+        )
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        try:
+            child = json.loads(line)
+        except ValueError:
+            problems.append(
+                f"precision child {setting!r} emitted no JSON "
+                f"(rc={proc.returncode}): "
+                f"{(proc.stderr or '').strip()[-300:]}"
+            )
+            continue
+        rel = child["rms_vs_dft_oracle"] * n_img * n_img
+        leg = {
+            **child,
+            "rms_relative": float(f"{rel:.3e}"),
+            "budget_relative": budget_rel,
+            "within_budget": bool(rel <= budget_rel),
+        }
+        legs.append(leg)
+        if not leg["within_budget"]:
+            problems.append(
+                f"SWIFTLY_PRECISION={setting}: relative RMS {rel:.3e} "
+                f"over the documented budget {budget_rel:.1e} "
+                "(docs/accuracy.md)"
+            )
+    head = next(
+        (l for l in legs if l["precision"] == "highest"),
+        legs[0] if legs else None,
+    )
+    if head is None:
+        problems.append("no precision leg produced a measurement")
+        head = {"wall_s": 0.0, "rms_vs_dft_oracle": 0.0, "platform": "?"}
+    record = {
+        "metric": f"{name} forward facet->subgrid wall-clock "
+                  f"(SWIFTLY_PRECISION={head.get('precision', '?')}, "
+                  f"planar f32, streamed, {head['platform']})",
+        "value": head["wall_s"],
+        "unit": "s",
+        "rms_vs_dft_oracle": head["rms_vs_dft_oracle"],
+        "precision": {
+            "budget_relative": PRECISION_RMS_BUDGET_REL,
+            "legs": legs,
+        },
+    }
+    record["manifest"] = run_manifest(
+        baseline_source=None,
+        params={"config": name, "mode": "precision", **params},
+    )
+    problems.extend(validate_artifact(record, require_baseline=False))
+    import json as _json
+
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    print(
+        json.dumps(
+            {
+                "precision_smoke" if smoke_mode else "precision": (
+                    "ok" if not problems else "failed"
+                ),
+                "config": name,
+                "artifact": out_path,
+                "legs": [
+                    {
+                        "precision": l["precision"],
+                        "wall_s": l["wall_s"],
+                        "rms_relative": l["rms_relative"],
+                        "within_budget": l["within_budget"],
+                    }
+                    for l in legs
+                ],
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
+
+
 def smoke():
     """Fast schema-validation leg (`bench.py --smoke`, wired into the
     tier-1 tests): run the 1k round trip with telemetry ON, write the
@@ -2899,6 +3399,12 @@ def main():
         sys.exit(chaos(smoke_mode="--smoke" in sys.argv))
     if "--mesh" in sys.argv:
         sys.exit(mesh_bench(smoke_mode="--smoke" in sys.argv))
+    if "--precision-child" in sys.argv:
+        sys.exit(precision_child())
+    if "--precision" in sys.argv:
+        sys.exit(precision_bench(smoke_mode="--smoke" in sys.argv))
+    if "--delta" in sys.argv:
+        sys.exit(delta_bench(smoke_mode="--smoke" in sys.argv))
     if "--smoke" in sys.argv:
         sys.exit(smoke())
 

@@ -31,6 +31,18 @@ artifact) and exits non-zero when a leg regressed:
   single-chip engine, higher is better) more than the threshold below
   the best same-platform reference — multi-chip scaling that quietly
   decays is a capacity regression even when the single-chip wall holds.
+* **delta speedup** — for incremental-update legs (``--delta``
+  artifacts): the ``delta.speedup_vs_full`` metric (full re-record
+  wall over patch wall, higher is better) more than the threshold
+  below the best same-platform reference — an incremental engine that
+  quietly degrades toward full-recompute cost is a regression even
+  when the full-record wall holds.
+* **precision RMS** — for accuracy legs (``--precision`` artifacts):
+  the ``rms_vs_dft_oracle`` metric (lower is better) more than the
+  threshold above the best (lowest) same-platform reference — a
+  numerical-accuracy regression trips the sentinel exactly like a
+  wall regression (the absolute budget lives in bench itself, see
+  docs/accuracy.md; this guards the *relative* trajectory).
 
 Legs are matched by (config, mode) — taken from the stamped
 ``manifest.config_params`` when present (every record since PR 1),
@@ -138,7 +150,7 @@ def compare(latest_records, reference_records, threshold=0.2):
         bucket = refs.setdefault(
             (key, leg_platform(rec)),
             {"wall": None, "mfu": None, "p99": None, "rps": None,
-             "se": None, "n": 0},
+             "se": None, "dse": None, "rms": None, "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -161,6 +173,14 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(se, (int, float)) and se > 0:
             if bucket["se"] is None or se > bucket["se"]:
                 bucket["se"] = se
+        dse = (rec.get("delta") or {}).get("speedup_vs_full")
+        if isinstance(dse, (int, float)) and dse > 0:
+            if bucket["dse"] is None or dse > bucket["dse"]:
+                bucket["dse"] = dse
+        rms = rec.get("rms_vs_dft_oracle")
+        if isinstance(rms, (int, float)) and rms > 0:
+            if bucket["rms"] is None or rms < bucket["rms"]:
+                bucket["rms"] = rms
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -255,6 +275,35 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"scaling efficiency {se:.4g} is "
                     f"{100 * (1 - se / ref['se']):.1f}% below best "
                     f"reference {ref['se']:.4g}"
+                )
+        # delta legs: incremental-update speedup sentinel (higher is
+        # better) — degradation toward full-recompute cost
+        dse = (rec.get("delta") or {}).get("speedup_vs_full")
+        if isinstance(dse, (int, float)) and dse > 0:
+            verdict["delta_speedup"] = dse
+            verdict["ref_delta_speedup"] = ref["dse"]
+            if (
+                ref["dse"] is not None
+                and dse < ref["dse"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"delta speedup {dse:.4g}x is "
+                    f"{100 * (1 - dse / ref['dse']):.1f}% below best "
+                    f"reference {ref['dse']:.4g}x"
+                )
+        # precision legs: accuracy sentinel (lower is better)
+        rms = rec.get("rms_vs_dft_oracle")
+        if isinstance(rms, (int, float)) and rms > 0:
+            verdict["rms_vs_dft_oracle"] = rms
+            verdict["ref_rms_vs_dft_oracle"] = ref["rms"]
+            if (
+                ref["rms"] is not None
+                and rms > ref["rms"] * (1.0 + threshold)
+            ):
+                verdict["problems"].append(
+                    f"rms {rms:.4g} is "
+                    f"{100 * (rms / ref['rms'] - 1):.1f}% above best "
+                    f"reference {ref['rms']:.4g}"
                 )
         legs.append(verdict)
         if verdict["problems"]:

@@ -1,0 +1,94 @@
+"""Operator entry for the incremental re-transform engine.
+
+Runs the ``bench.py --delta`` leg: record the full subgrid stream
+once, mutate K of J facets, and verify that the facet-delta patch path
+(`swiftly_tpu.delta.IncrementalForward`) reproduces the full re-record
+within the documented f32 sum-reorder tolerance — then write the
+schema-validated ``delta`` artifact block ({changed_facets,
+patched_columns, speedup_vs_full, max_abs_diff, plan}).
+
+Knobs map 1:1 onto the bench env contract:
+
+* ``--config``  -> BENCH_DELTA_CONFIG (default: bench's own —
+  1k smoke / 4k full)
+* ``--k``       -> BENCH_DELTA_K, comma list of changed-facet counts
+  (default "1,3")
+* ``--out``     -> BENCH_DELTA_OUT (default BENCH_delta.json)
+* ``--exact``   -> SWIFTLY_DELTA_EXACT=1: force the full-replay path
+  so patched and fresh streams are BIT-identical (the audit then
+  requires max_abs_diff == 0, not just within-tolerance)
+* ``--smoke``   -> the smoke-scale config + pass counts
+
+The drill runs on CPU by default (``JAX_PLATFORMS=cpu`` unless the
+caller already pinned a platform) so an operator can rehearse an
+update rollout on a laptop before touching the fleet; on accelerator
+hosts drop the pin via ``JAX_PLATFORMS=`` in the environment.
+
+Usage:
+    python scripts/delta_drill.py --smoke            # laptop rehearsal
+    python scripts/delta_drill.py --config 4k[1]-n2k-512 --k 1,3
+    python scripts/delta_drill.py --smoke --exact    # bit-exact ladder
+
+Exit: bench's status — 0 on a green leg (artifact validated, every K
+within tolerance, patch path actually taken), non-zero otherwise.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="incremental-update drill: run the bench.py "
+                    "--delta leg (facet mutation -> cache patch -> "
+                    "full-recompute audit) with operator knobs"
+    )
+    ap.add_argument(
+        "--config", default=None,
+        help="catalogue config name (BENCH_DELTA_CONFIG; default: "
+             "bench's own — 1k smoke / 4k full)",
+    )
+    ap.add_argument(
+        "--k", default=None,
+        help="comma list of changed-facet counts to drill "
+             "(BENCH_DELTA_K, default '1,3')",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="artifact path (BENCH_DELTA_OUT, default BENCH_delta.json)",
+    )
+    ap.add_argument(
+        "--exact", action="store_true",
+        help="SWIFTLY_DELTA_EXACT=1: force full replay for bit-exact "
+             "results instead of the in-place patch",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="smoke-scale config + pass counts",
+    )
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if args.config:
+        env["BENCH_DELTA_CONFIG"] = args.config
+    if args.k:
+        env["BENCH_DELTA_K"] = args.k
+    if args.out:
+        env["BENCH_DELTA_OUT"] = args.out
+    if args.exact:
+        env["SWIFTLY_DELTA_EXACT"] = "1"
+
+    cmd = [sys.executable, str(REPO / "bench.py"), "--delta"]
+    if args.smoke:
+        cmd.append("--smoke")
+    return subprocess.run(cmd, env=env).returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

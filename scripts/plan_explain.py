@@ -106,6 +106,12 @@ def main(argv=None):
              "SWIFTLY_SPILL_DIR)",
     )
     ap.add_argument(
+        "--delta", type=int, default=None, metavar="K",
+        help="print the incremental-update break-even table instead: "
+             "price a K-of-J changed-facet patch (delta stream + cache "
+             "patch) against the full re-record (plan.plan_delta)",
+    )
+    ap.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit the plan's artifact block as JSON instead of the "
              "human report",
@@ -116,6 +122,7 @@ def main(argv=None):
         PlanInputs,
         compile_plan,
         hbm_budget_bytes,
+        plan_delta,
         refit,
     )
 
@@ -134,6 +141,17 @@ def main(argv=None):
         fold_group=args.fold_group, max_batch=args.max_batch,
     )
     coeffs = refit(args.history) if args.history else None
+    if args.delta is not None:
+        try:
+            dplan = plan_delta(inputs, args.delta, coeffs=coeffs)
+        except ValueError as exc:
+            print(exc.args[0], file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(dplan.as_dict(), indent=2))
+        else:
+            print(dplan.explain())
+        return 0
     plan = compile_plan(
         inputs, coeffs=coeffs, mode=args.mode,
         spill_dir=args.spill_dir, feed_env=args.feed_group,

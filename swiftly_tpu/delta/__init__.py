@@ -1,0 +1,27 @@
+"""Incremental re-transform engine (ROADMAP item 5b).
+
+The facet -> subgrid map is linear in the facets, so a K-of-J facet
+update costs ~K/J of a streamed forward plus a patch of the recorded
+subgrid stream — this package is that update path:
+
+* `ledger.FacetDeltaLedger` — content-hashed facet-stack versioning;
+  the monotone ``stream_version`` it stamps into the spill cache is
+  what invalidates stale feeds and checkpoints.
+* `engine.IncrementalForward` — record once, then ``update()`` streams
+  only the changed facets' deltas and patches the cached stream in
+  place (falling back to a full re-record on any patch failure, and
+  under ``SWIFTLY_DELTA_EXACT=1``).
+
+See docs/incremental.md; `plan.plan_delta` prices the break-even and
+``bench.py --delta`` is the measured drill.
+"""
+
+from .engine import IncrementalForward, facet_delta
+from .ledger import FacetDeltaLedger, facet_hash
+
+__all__ = [
+    "FacetDeltaLedger",
+    "IncrementalForward",
+    "facet_delta",
+    "facet_hash",
+]

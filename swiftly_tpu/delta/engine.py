@@ -1,0 +1,326 @@
+"""`IncrementalForward`: facet-delta updates that patch the recorded
+subgrid stream instead of recomputing it.
+
+The facet -> subgrid map ``S_i = A_i sum_j ( n_j * m_i (b_j * F_j) )``
+is LINEAR in the facets: a changed facet contributes additively, so for
+K changed facets of J the correction to every subgrid is exactly a
+streamed forward over the K delta facets ``dF_j = F_j_new - F_j_old``
+— ~K/J of a full forward's compute — added into the recorded stream.
+This engine wraps `parallel.streamed.StreamedForward` with that
+workflow:
+
+1. ``record(subgrid_configs)`` runs one full streamed forward,
+   persisting the stream into a `utils.spill.SpillCache` and committing
+   the facet stack to a `delta.ledger.FacetDeltaLedger`;
+2. ``update(new_facet_tasks)`` detects the changed facets by content
+   hash, streams the column passes with the facet stack RESTRICTED to
+   those K deltas, routes every correction row onto its recorded cache
+   position via the spill metadata's input indices (robust to the delta
+   pass choosing a different column grouping than the recording run),
+   and patches each cache entry in place — one atomic
+   `SpillCache.patch_entry` per group (RAM in-place add, or disk
+   tmp-sibling + rename) — then bumps the ledger's ``stream_version``
+   into the cache so stale feeds invalidate.
+
+Exactness contract (docs/incremental.md): the patched stream equals a
+full recompute up to f32 sum-reorder error — the delta adds facet
+contributions in a different association order than the fused
+column-pass einsum. ``SWIFTLY_DELTA_EXACT=1`` (or ``exact=True``)
+re-records the stream from scratch with the new stack instead:
+bit-identical to a fresh forward, at full-forward cost — the
+correctness escape hatch, not the fast path.
+
+Failure posture (the PR-4 degradation ladder): ANY failure on the
+patch path — a delta-stream error, an unmappable row, a patch write
+that stays failed past its retries — degrades to a full re-record of
+the stream with the new stack (``delta.patch_to_replay`` in the
+degradation ledger). Slower, never wrong; a partially-patched cache is
+impossible to observe because the replay re-fills every entry.
+
+Break-even: `plan.plan_delta` prices the incremental path against the
+full recompute from the same stage coefficients; ``update`` honours
+the cheaper choice (and records the plan in its report).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..parallel.streamed import CachedColumnFeed, StreamedForward
+from ..resilience import degrade as _degrade
+from .ledger import FacetDeltaLedger
+
+__all__ = ["IncrementalForward", "facet_delta"]
+
+logger = logging.getLogger(__name__)
+
+
+def facet_delta(old, new):
+    """``new - old`` for one facet's data, keeping sparse descriptors
+    sparse (the concatenated coordinate lists with negated old values —
+    duplicates accumulate in both the host densify and the device
+    scatter, so the result is exact)."""
+    from ..ops.oracle import SparseRealFacet
+
+    old = old() if callable(old) else old
+    new = new() if callable(new) else new
+    if isinstance(old, SparseRealFacet) and isinstance(new, SparseRealFacet):
+        if old.size != new.size:
+            raise ValueError(
+                f"facet size changed ({old.size} -> {new.size}); "
+                "not a delta"
+            )
+        return SparseRealFacet(
+            new.size,
+            np.concatenate([new.rows, old.rows]),
+            np.concatenate([new.cols, old.cols]),
+            np.concatenate([new.vals, -np.asarray(old.vals)]),
+        )
+    if isinstance(old, SparseRealFacet):
+        old = old.densify()
+    if isinstance(new, SparseRealFacet):
+        new = new.densify()
+    old = np.asarray(old)
+    new = np.asarray(new)
+    if old.shape != new.shape:
+        raise ValueError(
+            f"facet shape changed ({old.shape} -> {new.shape}); "
+            "not a delta"
+        )
+    return new - old
+
+
+class IncrementalForward:
+    """A streamed forward whose recorded output stream accepts
+    facet-delta patches.
+
+    :param swiftly_config: `SwiftlyConfig` (device backend)
+    :param facet_tasks: list of (FacetConfig, facet_data) pairs —
+        callables are materialised (the ledger hashes content)
+    :param spill: the `utils.spill.SpillCache` holding the recorded
+        stream (the memo the updates patch)
+    :param ledger: a `FacetDeltaLedger` (default: fresh)
+    :param col_group / facet_group: forwarded to `StreamedForward`
+    """
+
+    def __init__(self, swiftly_config, facet_tasks, spill, ledger=None,
+                 col_group=None, facet_group=None):
+        self.config = swiftly_config
+        self.facet_tasks = [
+            (fc, d() if callable(d) else d) for fc, d in facet_tasks
+        ]
+        self.spill = spill
+        self.ledger = ledger or FacetDeltaLedger()
+        self._col_group = col_group
+        self._facet_group = facet_group
+        self.fwd = self._make_fwd(self.facet_tasks)
+        self._subgrid_configs = None
+        self.last_report = None
+
+    def _make_fwd(self, tasks):
+        return StreamedForward(
+            self.config, tasks, residency="device",
+            col_group=self._col_group, facet_group=self._facet_group,
+        )
+
+    # -- record -------------------------------------------------------------
+
+    def record(self, subgrid_configs):
+        """Run one full streamed forward, persisting the stream; commits
+        the facet stack and stamps the stream version. Re-recording
+        (e.g. after an update chose replay) starts from a reset cache."""
+        self._subgrid_configs = list(subgrid_configs)
+        if len(self.spill):
+            self.spill.reset()
+        for _ in self.fwd.stream_column_groups(
+            self._subgrid_configs, spill=self.spill
+        ):
+            pass
+        if not self.spill.complete:
+            raise RuntimeError(
+                "the stream did not fit the spill cache (fill gave up); "
+                "incremental updates need a complete recording — raise "
+                "SWIFTLY_SPILL_BUDGET_GB or set SWIFTLY_SPILL_DIR"
+            )
+        self.ledger.commit(self.facet_tasks)
+        self.ledger.stamp(self.spill)
+        _trace.instant("delta.record", cat="delta",
+                       version=self.ledger.version,
+                       entries=len(self.spill))
+        return {"stream_version": self.ledger.version,
+                "entries": len(self.spill)}
+
+    def feed(self):
+        """A fresh `CachedColumnFeed` over the recorded stream, pinned
+        to the CURRENT stream version."""
+        return CachedColumnFeed(self.spill)
+
+    # -- update -------------------------------------------------------------
+
+    def update(self, new_facet_tasks, exact=None, use_plan=True):
+        """Adopt ``new_facet_tasks``; patch or re-record the stream.
+
+        Returns a report dict: ``mode`` ("patch" | "replay" | "noop"),
+        ``reason`` (why replay/noop), ``changed_facets``,
+        ``patched_columns`` / ``patched_entries``, ``stream_version``
+        and ``plan`` (the `plan.plan_delta` pricing, when available).
+        """
+        if self._subgrid_configs is None:
+            raise ValueError("record() must run before update()")
+        tasks = [
+            (fc, d() if callable(d) else d) for fc, d in new_facet_tasks
+        ]
+        changed = self.ledger.changed(tasks)
+        if not changed:
+            self.last_report = {
+                "mode": "noop", "reason": "no_facets_changed",
+                "changed_facets": [], "patched_columns": 0,
+                "patched_entries": 0,
+                "stream_version": self.ledger.version, "plan": None,
+            }
+            return self.last_report
+        if exact is None:
+            exact = os.environ.get("SWIFTLY_DELTA_EXACT") == "1"
+        plan_dict = self._plan(len(changed)) if use_plan else None
+        reason = None
+        if exact:
+            reason = "exact_mode"
+        elif not self.spill.complete:
+            reason = "incomplete_cache"
+        elif len(changed) >= len(tasks):
+            reason = "all_facets_changed"
+        elif plan_dict is not None and plan_dict.get("mode") == "full":
+            reason = "plan_break_even"
+        if reason is not None:
+            return self._replay(tasks, changed, reason, plan_dict)
+        try:
+            corrections, patched_columns = self._stream_delta(
+                tasks, changed
+            )
+            for k in sorted(corrections):
+                self.spill.patch_entry(k, corrections[k])
+        except Exception as exc:  # noqa: BLE001 - the degradation ladder
+            # rung: patch -> replay. A torn patch (some entries updated,
+            # some not) is unobservable: the replay re-fills every entry
+            # from the new stack.
+            logger.warning(
+                "incremental patch failed (%s: %s); replaying the full "
+                "forward with the new facet stack",
+                type(exc).__name__, exc,
+            )
+            _degrade.record(
+                "delta", "patch_to_replay",
+                f"{type(exc).__name__}: {exc}",
+            )
+            _metrics.count("delta.patch_failures")
+            return self._replay(
+                tasks, changed, "patch_failed", plan_dict
+            )
+        self._adopt(tasks)
+        self.ledger.commit(self.facet_tasks)
+        self.ledger.stamp(self.spill)
+        _metrics.count("delta.patches")
+        _metrics.count("delta.patched_entries", len(corrections))
+        _trace.instant("delta.patch", cat="delta",
+                       changed=len(changed),
+                       entries=len(corrections),
+                       version=self.ledger.version)
+        self.last_report = {
+            "mode": "patch", "reason": None,
+            "changed_facets": list(changed),
+            "patched_columns": int(patched_columns),
+            "patched_entries": len(corrections),
+            "stream_version": self.ledger.version,
+            "plan": plan_dict,
+        }
+        return self.last_report
+
+    # -- internals ----------------------------------------------------------
+
+    def _plan(self, n_changed):
+        """Price incremental vs full via `plan.plan_delta`; None when
+        the geometry cannot be priced (pricing is advisory — the engine
+        still has the exactness ladder either way)."""
+        try:
+            from ..plan import PlanInputs, plan_delta
+
+            inputs = PlanInputs.from_cover(
+                self.config,
+                [fc for fc, _ in self.facet_tasks],
+                self._subgrid_configs,
+            )
+            return plan_delta(inputs, n_changed).as_dict()
+        except Exception as exc:  # noqa: BLE001 - pricing is advisory
+            logger.debug("plan_delta unavailable: %s", exc)
+            return None
+
+    def _stream_delta(self, tasks, changed):
+        """Stream the K delta facets; return ``{entry_k: correction}``
+        (one dense [G, S, ...] addend per cache entry) plus the number
+        of distinct columns the corrections touch."""
+        delta_tasks = [
+            (self.facet_tasks[j][0],
+             facet_delta(self.facet_tasks[j][1], tasks[j][1]))
+            for j in changed
+        ]
+        dfwd = self._make_fwd(delta_tasks)
+        # Cache positions by the cover's input index: the delta pass may
+        # group columns differently than the recording run (its column
+        # grouping auto-sizes from K facets, not J), so rows are routed
+        # by identity, never by position.
+        pos = {}
+        for k in range(len(self.spill)):
+            for c, col in enumerate(self.spill.meta(k)):
+                for s, (i, _sg) in enumerate(col):
+                    pos[int(i)] = (k, c, s)
+        corrections = {}
+        columns = set()
+        for per_col, out_g in dfwd.stream_column_groups(
+            self._subgrid_configs
+        ):
+            with _metrics.stage("delta.d2h") as st:
+                host = np.asarray(out_g)
+                st.bytes_moved = int(host.nbytes)
+            for c, col in enumerate(per_col):
+                for s, (i, _sg) in enumerate(col):
+                    k, cc, ss = pos[int(i)]
+                    corr = corrections.get(k)
+                    if corr is None:
+                        corr = corrections[k] = np.zeros(
+                            self.spill.get(k).shape, dtype=host.dtype
+                        )
+                    corr[cc, ss] += host[c, s]
+                    columns.add((k, cc))
+        return corrections, len(columns)
+
+    def _replay(self, tasks, changed, reason, plan_dict):
+        """Full re-record with the new stack — the exact path and the
+        ladder's landing zone. Bit-identical to a fresh forward."""
+        self._adopt(tasks)
+        self.spill.reset()
+        for _ in self.fwd.stream_column_groups(
+            self._subgrid_configs, spill=self.spill
+        ):
+            pass
+        self.ledger.commit(self.facet_tasks)
+        self.ledger.stamp(self.spill)
+        _metrics.count("delta.replays")
+        _trace.instant("delta.replay", cat="delta", reason=reason,
+                       version=self.ledger.version)
+        self.last_report = {
+            "mode": "replay", "reason": reason,
+            "changed_facets": list(changed),
+            "patched_columns": 0, "patched_entries": 0,
+            "stream_version": self.ledger.version,
+            "plan": plan_dict,
+        }
+        return self.last_report
+
+    def _adopt(self, tasks):
+        self.facet_tasks = tasks
+        self.fwd = self._make_fwd(tasks)

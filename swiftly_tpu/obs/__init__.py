@@ -41,6 +41,7 @@ from .heartbeat import Heartbeat, PartialArtifactWriter
 from .manifest import (
     run_manifest,
     validate_artifact,
+    validate_delta_artifact,
     validate_fleet_artifact,
     validate_mesh_artifact,
     validate_plan_artifact,
@@ -58,6 +59,7 @@ __all__ = [
     "summarize_trace",
     "trace",
     "validate_artifact",
+    "validate_delta_artifact",
     "validate_fleet_artifact",
     "validate_mesh_artifact",
     "validate_plan_artifact",

@@ -27,6 +27,7 @@ import time
 
 __all__ = [
     "BASELINE_SOURCES",
+    "DELTA_ARTIFACT_FIELDS",
     "FLEET_ARTIFACT_FIELDS",
     "MANIFEST_SCHEMA",
     "MESH_ARTIFACT_FIELDS",
@@ -36,6 +37,7 @@ __all__ = [
     "config_hash",
     "run_manifest",
     "validate_artifact",
+    "validate_delta_artifact",
     "validate_fleet_artifact",
     "validate_mesh_artifact",
     "validate_plan_artifact",
@@ -694,4 +696,92 @@ def validate_resilience_artifact(record):
             f"bit_identical is {res.get('bit_identical')!r}, the "
             "resumed run must match the undisturbed run exactly"
         )
+    return problems
+
+
+# The delta block every `bench.py --delta` artifact must carry — the
+# incremental-update drill's schema contract (which facets moved, how
+# many cached columns were patched, the patch-vs-full speedup, and the
+# audit that the patched stream matches a fresh full recompute).
+DELTA_ARTIFACT_FIELDS = (
+    "changed_facets",
+    "patched_columns",
+    "speedup_vs_full",
+    "max_abs_diff",
+    "plan",
+)
+
+
+def validate_delta_artifact(record):
+    """Problems with a delta-mode BENCH artifact, as a list of strings.
+
+    Delta legs carry no numpy baseline (the full re-record of the same
+    engine is the reference, timed in the block itself) but must carry
+    the full manifest plus a coherent ``delta`` block: at least one
+    changed facet, at least one patched column, a positive
+    speedup_vs_full, a match audit whose max |diff| sits inside the
+    stamped f32 sum-reorder tolerance, and a ``plan`` block whose mode
+    names the path actually taken (``"patch"`` or ``"full"``) — a delta
+    drill whose patched stream drifted past tolerance is a correctness
+    bug, not a speedup result.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    delta = record.get("delta")
+    if not isinstance(delta, dict):
+        problems.append("missing delta block")
+        return problems
+    for field in DELTA_ARTIFACT_FIELDS:
+        if field not in delta:
+            problems.append(f"delta block missing {field!r}")
+    changed = delta.get("changed_facets")
+    if isinstance(changed, list) and not changed:
+        problems.append("delta drill changed no facets")
+    elif changed is not None and not isinstance(changed, list):
+        problems.append(
+            f"changed_facets is {type(changed).__name__}, expected a "
+            "facet-index list"
+        )
+    pc = delta.get("patched_columns")
+    if isinstance(pc, int) and pc < 1 and not delta.get("exact_mode"):
+        # SWIFTLY_DELTA_EXACT=1 legs replay instead of patching —
+        # zero patched columns is the contract there, not a failure
+        problems.append("delta drill patched no cached columns")
+    sp = delta.get("speedup_vs_full")
+    if sp is not None and (not isinstance(sp, (int, float)) or sp <= 0):
+        problems.append(
+            f"speedup_vs_full {sp!r} is not a positive number"
+        )
+    match = delta.get("match")
+    if not isinstance(match, dict) or not (
+        {"max_abs_diff", "tolerance", "within_tolerance"} <= set(match)
+    ):
+        problems.append(
+            "missing match {max_abs_diff, tolerance, within_tolerance} "
+            "block"
+        )
+    else:
+        if match.get("within_tolerance") is not True:
+            problems.append(
+                f"patched stream outside the f32 sum-reorder "
+                f"tolerance: {match}"
+            )
+        mad, tol = match.get("max_abs_diff"), match.get("tolerance")
+        if (
+            isinstance(mad, (int, float))
+            and isinstance(tol, (int, float))
+            and mad > tol
+        ):
+            problems.append(
+                f"match max_abs_diff {mad} > tolerance {tol} but "
+                "within_tolerance claims otherwise"
+            )
+    plan = delta.get("plan")
+    if isinstance(plan, dict):
+        mode = plan.get("mode")
+        if mode not in ("patch", "full"):
+            problems.append(
+                f"delta plan mode {mode!r} not in ('patch', 'full')"
+            )
+    elif plan is not None:
+        problems.append("delta plan block is not a dict")
     return problems

@@ -2161,6 +2161,14 @@ class CachedColumnFeed:
     (`serve.SubgridService`) treat that as the signal to fall back to
     recomputation, the serving twin of the cache's degrade-to-replay
     contract.
+
+    Version pinning: the feed captures the cache's ``stream_version``
+    at construction (the `delta.FacetDeltaLedger` stamp). Once an
+    incremental facet update moves the cache's version, every lookup
+    raises LookupError — a feed indexed before the patch can never
+    serve a row recorded (or patched) for a different facet stack;
+    consumers rebuild the feed (`serve.SubgridService
+    .post_facet_update`) or fall back to compute.
     """
 
     def __init__(self, spill):
@@ -2171,6 +2179,7 @@ class CachedColumnFeed:
                 "incomplete stream would silently miss-serve"
             )
         self._spill = spill
+        self.stream_version = int(getattr(spill, "stream_version", 0))
         self._index = {}  # (off0, off1, size) -> (k, c, s, recorded cfg)
         for k in range(len(spill)):
             for c, col in enumerate(spill.meta(k)):
@@ -2179,6 +2188,7 @@ class CachedColumnFeed:
         self.hits = 0
         self.misses = 0
         self.evicted = 0
+        self.stale = 0
 
     def __len__(self):
         return len(self._index)
@@ -2195,7 +2205,19 @@ class CachedColumnFeed:
 
     def lookup(self, config):
         """The recorded host row for ``config``, or None on a miss;
-        raises LookupError when the index hit an evicted entry."""
+        raises LookupError when the index hit an evicted entry or the
+        cache's stream version moved since this feed was built (a
+        facet update patched the rows — this feed is stale)."""
+        current = int(getattr(self._spill, "stream_version", 0))
+        if current != self.stream_version:
+            self.stale += 1
+            if _metrics.enabled():
+                _metrics.count("spill.feed_stale")
+            raise LookupError(
+                f"cached stream version moved "
+                f"({self.stream_version} -> {current}); this feed "
+                "indexes a superseded facet stack — rebuild it"
+            )
         hit = self._index.get((config.off0, config.off1, config.size))
         if hit is None or not self._masks_match(config, hit[3]):
             self.misses += 1

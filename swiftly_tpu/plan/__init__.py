@@ -31,12 +31,14 @@ from . import autotune, compiler, model
 from .autotune import load_history, refit
 from .compiler import (
     BackwardPlan,
+    DeltaPlan,
     MeshLayout,
     Plan,
     ServePlan,
     SpillPolicy,
     compile_plan,
     plan_backward_passes,
+    plan_delta,
     plan_mesh_layout,
 )
 from .model import (
@@ -52,6 +54,7 @@ from .model import (
 __all__ = [
     "BackwardPlan",
     "CostCoefficients",
+    "DeltaPlan",
     "MeshLayout",
     "Plan",
     "PlanInputs",
@@ -66,6 +69,7 @@ __all__ = [
     "load_history",
     "model",
     "plan_backward_passes",
+    "plan_delta",
     "plan_mesh_layout",
     "projected_column_bytes",
     "projected_request_bytes",

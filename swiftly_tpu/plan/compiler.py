@@ -50,6 +50,7 @@ from .model import (
 
 __all__ = [
     "BackwardPlan",
+    "DeltaPlan",
     "MeshLayout",
     "Plan",
     "ServePlan",
@@ -57,6 +58,7 @@ __all__ = [
     "compile_plan",
     "plan_backward_feed",
     "plan_backward_passes",
+    "plan_delta",
     "plan_mesh_layout",
 ]
 
@@ -793,6 +795,155 @@ def compile_plan(
         mesh=mesh,
         forward=_forward_prediction(inputs),
         predicted=predicted,
+        alternatives=alternatives,
+        coeffs_source=coeffs.source,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Incremental (facet-delta) planning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DeltaPlan:
+    """Incremental-vs-full pricing for a K-of-J facet update.
+
+    ``mode`` is the cheaper choice for the REQUESTED K ("patch" = delta
+    stream + cache patch, "full" = re-record); ``break_even_k`` the
+    smallest K at which the full recompute wins (J+1 when the patch
+    wins at every K — e.g. replay-mode streams where the full path
+    pays no re-record IO either way price differently). Every scanned
+    K is kept in ``alternatives`` so `scripts/plan_explain.py --delta`
+    prints the whole break-even table, and the rejected choice for the
+    requested K is among them (``chosen`` flags), matching
+    `compile_plan`'s alternative-recording contract.
+    """
+
+    changed_facets: int
+    n_facets: int
+    mode: str  # "patch" | "full"
+    predicted_wall_s: float
+    full_wall_s: float
+    break_even_k: int
+    alternatives: list = field(default_factory=list)
+    coeffs_source: str = "default"
+
+    def as_dict(self):
+        return {
+            "changed_facets": int(self.changed_facets),
+            "n_facets": int(self.n_facets),
+            "mode": self.mode,
+            "predicted_wall_s": round(float(self.predicted_wall_s), 4),
+            "full_wall_s": round(float(self.full_wall_s), 4),
+            "break_even_k": int(self.break_even_k),
+            "coeffs_source": self.coeffs_source,
+            "alternatives": list(self.alternatives),
+        }
+
+    def explain(self):
+        """Human-readable break-even table
+        (``scripts/plan_explain.py --delta K``)."""
+        lines = [
+            f"delta plan: {self.changed_facets} of {self.n_facets} "
+            f"facet(s) changed -> {self.mode} "
+            f"({self.predicted_wall_s:.2f} s vs full "
+            f"{self.full_wall_s:.2f} s, {self.coeffs_source} "
+            "coefficients)",
+            f"  break-even: full recompute wins from K = "
+            f"{self.break_even_k}"
+            + (
+                " (never within this cover)"
+                if self.break_even_k > self.n_facets
+                else ""
+            ),
+            "  K  patch_wall_s  full_wall_s  choice",
+        ]
+        for alt in self.alternatives:
+            mark = " *" if alt.get("chosen") else ""
+            lines.append(
+                f"  {alt['changed_facets']:>2}  "
+                f"{alt['patch_wall_s']:>12.3f}  "
+                f"{alt['full_wall_s']:>11.3f}  "
+                f"{alt['mode']}{mark}"
+            )
+        return "\n".join(lines)
+
+
+def plan_delta(inputs, changed_facets, coeffs=None, history=None):
+    """Price a K-changed-facet incremental update against the full
+    streamed recompute; pick the cheaper (`DeltaPlan`).
+
+    The incremental path prices a forward RESTRICTED to the K delta
+    facets (the linearity argument of docs/incremental.md: the
+    restricted column pass is exactly the additive correction) plus the
+    patch IO — the delta stream's d2h pull and the cached stream's
+    read-modify-write. The full path prices the whole-stack forward
+    plus the re-record d2h. Both use the same stage coefficients as
+    `compile_plan` — with ``history``, `autotune.refit`'s measured
+    rates (autotune-refittable break-even).
+    """
+    if coeffs is None:
+        if history:
+            from .autotune import refit
+
+            coeffs = refit(history)
+        else:
+            coeffs = CostCoefficients()
+    k = int(changed_facets)
+    n = int(inputs.n_facets)
+    if not 1 <= k <= n:
+        raise ValueError(
+            f"changed_facets must be in [1, {n}] (got {k})"
+        )
+    stream = int(inputs.stream_bytes)
+
+    def patch_wall(kk):
+        # fwd restricted to the K deltas, plus the correction stream's
+        # d2h+store (every facet touches every column, so the
+        # correction spans the full stream — same bytes the full
+        # re-record moves), plus the patch's ONLY extra IO: reading
+        # the recorded base for the in-place add.
+        fwd = sum(
+            s.wall_s
+            for s in price_forward(inputs.replace(n_facets=kk), coeffs)
+        )
+        store = coeffs.price("spill.write", bytes_moved=stream).wall_s
+        base_read = coeffs.price("spill.read", bytes_moved=stream).wall_s
+        return fwd + store + base_read
+
+    full_wall = (
+        sum(s.wall_s for s in price_forward(inputs, coeffs))
+        + coeffs.price("spill.write", bytes_moved=stream).wall_s
+    )
+    alternatives = []
+    break_even = n + 1
+    for kk in range(1, n + 1):
+        pw = patch_wall(kk)
+        mode_k = "patch" if pw < full_wall else "full"
+        if mode_k == "full" and break_even > n:
+            break_even = kk
+        alternatives.append(
+            {
+                "changed_facets": kk,
+                "patch_wall_s": round(pw, 4),
+                "full_wall_s": round(full_wall, 4),
+                "mode": mode_k,
+                "chosen": kk == k,
+            }
+        )
+    chosen = alternatives[k - 1]
+    return DeltaPlan(
+        changed_facets=k,
+        n_facets=n,
+        mode=chosen["mode"],
+        predicted_wall_s=(
+            chosen["patch_wall_s"]
+            if chosen["mode"] == "patch"
+            else full_wall
+        ),
+        full_wall_s=full_wall,
+        break_even_k=break_even,
         alternatives=alternatives,
         coeffs_source=coeffs.source,
     )

@@ -876,6 +876,34 @@ class ServeFleet:
                     return not self._pending
             time.sleep(0.002)
 
+    def post_facet_update(self, engine, new_facet_tasks, **update_kw):
+        """Fleet-wide incremental facet update: run the
+        `delta.IncrementalForward` update ONCE (one delta stream + one
+        cache patch, or its degradation ladder), then propagate the
+        patched feed and the new stream version to every replica's
+        service. Each replica drains its own in-flight requests before
+        adopting the feed, so version pinning holds per replica; there
+        is no fleet-wide stop-the-world and no cache flush.
+        """
+        report = engine.update(new_facet_tasks, **update_kw)
+        for replica in self._replicas.values():
+            # a fresh feed per replica: feeds carry per-feed stale/hit
+            # state and the captured version, so replicas must not
+            # share one object
+            replica.service.post_facet_update(
+                report=report, feed=engine.feed()
+            )
+        self._counts["facet_updates"] = (
+            self._counts.get("facet_updates", 0) + 1
+        )
+        _metrics.count("fleet.facet_updates")
+        _trace.instant(
+            "fleet.facet_update", cat="fleet",
+            stream_version=report.get("stream_version"),
+            mode=report.get("mode"),
+        )
+        return report
+
     def kill_replica(self, rid):
         """Drill hook: simulated chip death for one replica."""
         self._replicas[rid].kill()

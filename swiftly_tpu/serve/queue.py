@@ -131,6 +131,7 @@ class SubgridRequest:
     __slots__ = (
         "config", "req_id", "priority", "submit_t", "deadline_t",
         "retries", "result", "_event", "take_t", "compute_t",
+        "stream_version",
     )
 
     def __init__(self, config, priority=0, deadline_s=None, now=None):
@@ -144,6 +145,12 @@ class SubgridRequest:
         self.retries = 0
         self.result = None
         self._event = threading.Event()
+        # the facet-stack version this request was admitted under
+        # (stamped by `SubgridService.submit`); the cache feed only
+        # serves version-matching requests, so an update mid-queue can
+        # never hand a request rows from a different stack than the
+        # one it was admitted against
+        self.stream_version = None
         # journey marks (set by the queue/pump): when the request left
         # the queue and when its compute landed — with submit_t and the
         # completion time these decompose end-to-end latency into

@@ -124,13 +124,34 @@ class SubgridService:
         called before each dispatch (attempt 0 = coalesced batch,
         >= 1 = isolated retries); an exception it raises is handled
         exactly like a compute failure
+    :param cover_columns: optional sparse-cover column list — an
+        iterable of served ``off0`` values (e.g. the streamed-sparse
+        bench path's partial-FoV cover). A request for any other
+        column is shed at the door with reason ``outside_cover``: a
+        partial-FoV service has no facet data for it, so computing
+        would silently return zeros. None (default) serves every
+        column.
     """
 
     def __init__(self, fwd, queue=None, scheduler=None, cache_feed=None,
                  timeout_s=None, max_retries=2, retry_backoff_s=0.005,
                  fuse_columns=1, slo_ms=None, fault_injector=None,
-                 hbm_budget_bytes=None, max_depth=256):
+                 hbm_budget_bytes=None, max_depth=256,
+                 cover_columns=None):
         self.fwd = fwd
+        self.cover_columns = (
+            None if cover_columns is None
+            else {int(c) for c in cover_columns}
+        )
+        # the current facet-stack version (bumped by
+        # `post_facet_update`); every admitted request is stamped with
+        # it so the cache feed serves only version-matching requests.
+        # Adopted from the feed at construction — a feed recorded at
+        # version v would otherwise version-gate EVERY request onto
+        # the compute path
+        self.stream_version = int(
+            getattr(cache_feed, "stream_version", 0)
+        )
         if queue is None:
             queue = AdmissionQueue(
                 max_depth=max_depth,
@@ -154,6 +175,7 @@ class SubgridService:
             "quarantined": 0, "retries": 0, "batches": 0,
             "batch_failures": 0, "batch_splits": 0, "coalesced": 0,
             "cache_hits": 0, "cache_fallbacks": 0, "slo_violations": 0,
+            "facet_updates": 0, "version_fallbacks": 0,
         }
         self._shed_reasons = {}
         self._latencies = []
@@ -179,8 +201,29 @@ class SubgridService:
             deadline_s = min(deadline_s, self.timeout_s)
         req = SubgridRequest(config, priority=priority,
                             deadline_s=deadline_s)
+        req.stream_version = self.stream_version
         self._counts["requests"] += 1
         _metrics.count("serve.requests")
+        if (
+            self.cover_columns is not None
+            and int(config.off0) not in self.cover_columns
+        ):
+            # sparse-cover locality: this service holds no facet data
+            # for the column — shed with a structured reason instead
+            # of computing a silent zero
+            self._counts["shed"] += 1
+            self._shed_reasons["outside_cover"] = (
+                self._shed_reasons.get("outside_cover", 0) + 1
+            )
+            _metrics.count("serve.shed")
+            _metrics.count("serve.shed.outside_cover")
+            _trace.instant("serve.shed", cat="serve",
+                           request_id=req.req_id,
+                           reason="outside_cover")
+            req._complete(
+                RequestResult(STATUS_SHED, shed_reason="outside_cover")
+            )
+            return req
         admitted, reason = self.queue.offer(req)
         if not admitted:
             if reason == "expired":
@@ -273,7 +316,22 @@ class SubgridService:
         the eviction fallback is the serving-path twin of the spill
         cache's degrade-to-replay contract)."""
         remaining = []
+        feed_version = getattr(
+            self.cache_feed, "stream_version", self.stream_version
+        )
         for req in requests:
+            if (
+                req.stream_version is not None
+                and req.stream_version != feed_version
+            ):
+                # version pinning: this request was admitted under a
+                # different facet-stack version than the feed serves —
+                # never hand it another version's rows; the compute
+                # path serves it against the forward it was admitted to
+                self._counts["version_fallbacks"] += 1
+                _metrics.count("serve.version_fallbacks")
+                remaining.append(req)
+                continue
             try:
                 with _metrics.stage("serve.cache_feed"):
                     row = self.cache_feed.lookup(req.config)
@@ -519,6 +577,60 @@ class SubgridService:
         self._thread.join(timeout)
         self._thread = None
 
+    # -- incremental facet updates ------------------------------------------
+
+    def post_facet_update(self, engine=None, new_facet_tasks=None, *,
+                          report=None, feed=None, fwd=None, **update_kw):
+        """Admit a new facet stack and serve from the patched cache.
+
+        Two calling shapes:
+
+        * ``post_facet_update(engine, new_facet_tasks)`` — run the
+          `delta.IncrementalForward` update here (delta-stream + cache
+          patch, or its degradation ladder) and adopt its feed;
+        * ``post_facet_update(report=..., feed=..., fwd=...)`` — adopt
+          a pre-computed update (the fleet runs ``engine.update`` ONCE
+          and propagates the result to every replica this way).
+
+        In-flight requests are pinned to the version they were admitted
+        under: the queue is DRAINED before the cache rows move, so
+        every pending request completes against the facet stack it was
+        admitted to; requests submitted after this returns carry the
+        new version and are served from the patched rows. No cache
+        flush — the feed swap is the only serving-path change.
+        """
+        if engine is None and report is None:
+            raise ValueError(
+                "post_facet_update needs an engine (to run the update) "
+                "or a pre-computed report"
+            )
+        # drain: in-flight requests complete at their admitted version
+        # BEFORE any cache row is patched out from under them (the
+        # worker thread contends on _pump_lock, so its pumps drain too)
+        while self.pump_once():
+            pass
+        with self._pump_lock:
+            if engine is not None:
+                report = engine.update(new_facet_tasks, **update_kw)
+                if feed is None:
+                    feed = engine.feed()
+            if fwd is not None:
+                self.fwd = fwd
+            if feed is not None and self.cache_feed is not None:
+                self.cache_feed = feed
+            self.stream_version = int(
+                report.get("stream_version", self.stream_version + 1)
+            )
+            self._counts["facet_updates"] += 1
+            _metrics.count("serve.facet_updates")
+            _trace.instant(
+                "serve.facet_update", cat="serve",
+                stream_version=self.stream_version,
+                mode=report.get("mode"),
+                changed_facets=report.get("changed_facets"),
+            )
+        return report
+
     # -- SLO export ---------------------------------------------------------
 
     def stats(self):
@@ -542,6 +654,9 @@ class SubgridService:
             "retry_backoff_s": round(self._backoff_slept_s, 4),
             "cache_hits": c["cache_hits"],
             "cache_fallbacks": c["cache_fallbacks"],
+            "stream_version": self.stream_version,
+            "facet_updates": c["facet_updates"],
+            "version_fallbacks": c["version_fallbacks"],
             "shed_rate": round(c["shed"] / requests, 4) if requests else 0.0,
             "shed_reasons": dict(self._shed_reasons),
             "coalesce_hit_rate": (

@@ -382,6 +382,10 @@ def save_streamed_backward_state(path, backward, processed_subgrids=None):
         "yB_pad": backward._base._yB_pad,
         "naf_keys": [],
         "processed": list(map(list, processed_subgrids or [])),
+        # monotone facet-stack version (delta.FacetDeltaLedger): a
+        # resume can tell whether the accumulators predate a facet
+        # update; 0 = unversioned, absent tolerated on restore
+        "stream_version": int(getattr(backward, "stream_version", 0)),
         # the mesh layout the accumulators were sharded with: resume
         # must restore onto the SAME sharding (facet padding and shard
         # ownership both depend on it) — None for single-device sessions

@@ -113,12 +113,19 @@ class SpillCache:
         self.complete = False
         self.gave_up = False
         self.tag = None  # stream identity (set by begin_fill)
+        # monotone facet-stack version (stamped by
+        # `delta.FacetDeltaLedger`); 0 = unversioned. Consumers that
+        # captured a version (`parallel.streamed.CachedColumnFeed`)
+        # refuse rows once it moves — a patched stream can never serve
+        # through a feed indexed before the patch.
+        self.stream_version = 0
         self.counters = {
             "writes": 0,
             "evictions": 0,
             "ram_reads": 0,
             "disk_reads": 0,
             "fills": 0,
+            "patches": 0,
         }
 
     # -- fill ---------------------------------------------------------------
@@ -264,6 +271,54 @@ class SpillCache:
             _metrics.count("spill.disk_reads")
         return out
 
+    # -- patch --------------------------------------------------------------
+
+    def patch_entry(self, k, delta):
+        """Add ``delta`` into entry k — the incremental engine's cache
+        patch (`delta.IncrementalForward`).
+
+        Atomic per entry: a RAM entry is one vectorised in-place add
+        (behind the ``spill.write`` fault site, BEFORE the add, so a
+        retried injection can never double-apply); a disk entry is
+        read, added, and rewritten through the same tmp-sibling +
+        rename path as the fill — a crash mid-patch leaves the old
+        entry intact, never a torn one. A failure that outlives the
+        retries raises; the caller's ladder degrades to a full
+        re-record.
+        """
+        kind, payload = self._entries[k]
+        delta = np.asarray(delta)
+        base = self.get(k)
+        if base.shape != delta.shape:
+            raise ValueError(
+                f"patch shape {delta.shape} != entry {k} shape "
+                f"{base.shape}"
+            )
+        add = delta.astype(base.dtype, copy=False)
+        if kind == "ram":
+            if not payload.flags.writeable:
+                # recorded entries are zero-copy views of device arrays
+                # (read-only buffers); the first patch owns a writable
+                # copy — later patches add in place
+                payload = np.array(payload)
+                self._entries[k] = ("ram", payload)
+
+            def write():
+                fault_point("spill.write")
+                with _metrics.stage("spill.patch") as st:
+                    np.add(payload, add, out=payload)
+                    st.bytes_moved = int(add.nbytes)
+
+            retry_transient(write, site="spill.write")
+        else:
+            with _metrics.stage("spill.patch") as st:
+                self._disk_write(k, base + add)
+                st.bytes_moved = int(add.nbytes)
+        self.counters["patches"] += 1
+        _metrics.count("spill.patches")
+        _trace.instant("spill.patch", cat="spill", entry=int(k),
+                       nbytes=int(add.nbytes))
+
     # -- maintenance --------------------------------------------------------
 
     def reset(self):
@@ -283,6 +338,7 @@ class SpillCache:
             "disk_bytes": int(self.disk_bytes),
             "budget_bytes": int(self.budget_bytes),
             "disk_backed": self.spill_dir is not None,
+            "stream_version": int(self.stream_version),
             **self.counters,
         }
         if self.policy is not None:

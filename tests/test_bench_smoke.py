@@ -496,3 +496,133 @@ def test_bench_chaos_full_drill(tmp_path):
     from swiftly_tpu.obs import validate_resilience_artifact
 
     assert validate_resilience_artifact(record) == []
+
+
+def test_bench_delta_smoke_leg(tmp_path):
+    """The `bench.py --delta --smoke` leg (ISSUE-11 acceptance), run
+    exactly as the driver would — fresh subprocess, CPU: record the 1k
+    stream once, patch K in {1, 3} facet updates into the cached
+    stream, audit against a fresh full recompute within the f32
+    sum-reorder tolerance, bit-identical exact replay, and the
+    ``delta`` artifact block through `obs.validate_delta_artifact` —
+    plus the speedup_vs_full sentinel wiring in bench_compare."""
+    out = tmp_path / "BENCH_delta.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_DELTA_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--delta", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["delta_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["patched_columns"] >= 1
+
+    # re-validate the artifact out-of-process (the leg's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_delta_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_delta_artifact(record) == []
+    delta = record["delta"]
+    assert delta["changed_facets"]
+    assert delta["patched_columns"] >= 1
+    assert delta["speedup_vs_full"] > 1.0
+    assert delta["match"]["within_tolerance"] is True
+    assert delta["exact"]["mode"] == "replay"
+    assert delta["exact"]["bit_identical"] is True
+    assert all(
+        leg["match"]["within_tolerance"] for leg in delta["legs"]
+    )
+    assert delta["plan"] is not None and delta["plan"]["mode"] == "patch"
+    assert delta["spill"]["complete"]
+    assert record["manifest"]["device"]["platform"] == "cpu"
+
+    # --- the incremental-speedup sentinel (in-process) ----------------
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_delta_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 0
+    # doctored 2x-better speedup reference -> the sentinel must trip
+    doctored = json.loads(out.read_text())
+    doctored["delta"]["speedup_vs_full"] = (
+        delta["speedup_vs_full"] * 2.0
+    )
+    doctored["value"] = record["value"]  # wall unchanged: isolate it
+    ref.write_text(json.dumps(doctored))
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 1
+
+
+@pytest.mark.slow
+def test_bench_precision_smoke_leg(tmp_path):
+    """The `bench.py --precision --smoke` leg: one child interpreter
+    per SWIFTLY_PRECISION setting (the flag bakes in at trace time)
+    measuring RMS against the DFT oracle, each asserted inside the
+    docs/accuracy.md error-budget table — slow-gated (two extra
+    interpreter spins); the budget table itself is import-checked in
+    tier-1 via the delta/precision bench module."""
+    out = tmp_path / "BENCH_precision.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_PRECISION_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--precision",
+         "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["precision_smoke"] == "ok", summary
+    assert summary["problems"] == []
+
+    from swiftly_tpu.obs import validate_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_artifact(record, require_baseline=False) == []
+    legs = record["precision"]["legs"]
+    assert {leg["precision"] for leg in legs} == {"highest", "high"}
+    for leg in legs:
+        assert leg["within_budget"] is True
+        assert leg["rms_relative"] <= leg["budget_relative"]
+    # HIGHEST must actually buy accuracy over HIGH on the same leg
+    by = {leg["precision"]: leg["rms_relative"] for leg in legs}
+    assert by["highest"] <= by["high"]
+
+
+def test_precision_budget_table_matches_docs():
+    """The error-budget table the --precision leg asserts against is
+    the one docs/accuracy.md documents — a budget edited in one place
+    but not the other fails here, in tier-1, not in a bench run."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    table = bench.PRECISION_RMS_BUDGET_REL
+    assert set(table) == {"highest", "high", "default"}
+    assert 0 < table["highest"] < table["high"]
+    assert table["default"] == table["high"]  # platform-dependent leg
+
+    doc = (REPO / "docs" / "accuracy.md").read_text()
+
+    def fmt(x):  # 0.0003 -> "3e-4", the doc table's spelling
+        mantissa, exp = f"{x:e}".split("e")
+        return f"{float(mantissa):g}e{int(exp)}"
+
+    for setting in ("highest", "high"):
+        assert f"`{setting}`" in doc
+        assert fmt(table[setting]) in doc, (
+            f"docs/accuracy.md does not document the {setting} budget "
+            f"{fmt(table[setting])}"
+        )

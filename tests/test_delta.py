@@ -1,0 +1,534 @@
+"""Incremental re-transform engine tests (`swiftly_tpu.delta`).
+
+The facet -> subgrid map is linear in the facets, so a K-of-J facet
+update is a streamed forward over the K deltas added into the recorded
+stream (~K/J of a full forward). Pinned here:
+
+* PATCH CORRECTNESS — the patched spill stream equals a fresh full
+  recompute of the new stack within the documented f32 sum-reorder
+  tolerance (docs/incremental.md), and ``exact=True`` /
+  ``SWIFTLY_DELTA_EXACT=1`` replays BIT-identically;
+* LEDGER SEMANTICS — content-addressed versioning: idempotent commits,
+  change detection by content (not identity), hard errors on cover
+  changes, lazy-callable materialisation, and the empty-facet edge
+  (scaling zero pixels is content-identical);
+* DEGRADATION LADDER — a patch write that stays failed past its
+  retries degrades to a full replay (``delta.patch_to_replay``),
+  bit-identical to a fresh forward: slower, never wrong;
+* VERSION PINNING — a `CachedColumnFeed` built before an update
+  refuses to serve after it (LookupError), and through
+  `SubgridService.post_facet_update` in-flight requests drain at their
+  admitted version while post-update requests serve the patched rows —
+  no pre-update cached row is ever returned for a post-update request;
+* SPARSE-COVER SERVING — ``cover_columns`` sheds out-of-cover requests
+  at the door with reason ``outside_cover``;
+* PLANNING — `plan.plan_delta` prices patch vs full from the shared
+  stage coefficients with a monotone break-even K.
+
+The 32k acceptance drill (K=1 at >= 4x over the full re-record) is
+``-m slow``-gated; tier-1 runs the 1k cover.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SWIFT_CONFIGS,
+    SwiftlyConfig,
+    SwiftlyForward,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+    make_sparse_facet,
+)
+from swiftly_tpu.delta import (
+    FacetDeltaLedger,
+    IncrementalForward,
+    facet_delta,
+    facet_hash,
+)
+from swiftly_tpu.ops.oracle import SparseRealFacet
+from swiftly_tpu.parallel import StreamedForward
+from swiftly_tpu.utils.spill import SpillCache
+
+REPO = Path(__file__).resolve().parents[1]
+TEST_NAME = "1k[1]-n512-256"
+
+# spread sources (fractions of N, as in bench's _bench_sources) so
+# several facets carry content — content-free facets hash identical
+# under any value scaling and are useless as mutation targets
+_FRACTIONS = [
+    (-0.41, -0.37), (-0.23, 0.11), (-0.05, 0.43), (0.02, -0.19),
+    (0.17, 0.31), (0.29, -0.45), (0.36, 0.07), (0.44, -0.02),
+]
+
+# relative f32 sum-reorder tolerance (docs/incremental.md): the delta
+# adds facet contributions in a different association order
+REL_TOL = 1e-4
+
+
+@pytest.fixture(scope="module")
+def cover():
+    import jax.numpy as jnp
+
+    params = dict(SWIFT_CONFIGS[TEST_NAME])
+    params.setdefault("fov", 1.0)
+    config = SwiftlyConfig(backend="planar", dtype=jnp.float32, **params)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    N = config.image_size
+    sources = [
+        (1.0 + 0.25 * i, int(a * N), int(b * N))
+        for i, (a, b) in enumerate(_FRACTIONS)
+    ]
+    tasks = [
+        (fc, make_sparse_facet(N, fc, sources, dtype=np.float32))
+        for fc in facet_configs
+    ]
+    content = [
+        j for j, (_, f) in enumerate(tasks) if np.asarray(f.vals).size
+    ]
+    assert len(content) >= 2, "spread sources must land in >= 2 facets"
+    return config, tasks, subgrid_configs, content
+
+
+def _mutate(tasks, idxs, scale):
+    out = list(tasks)
+    for j in idxs:
+        fc, f = out[j]
+        out[j] = (
+            fc,
+            SparseRealFacet(
+                f.size, f.rows, f.cols,
+                np.asarray(f.vals) * np.float32(scale),
+            ),
+        )
+    return out
+
+
+def _engine(cover):
+    config, tasks, sgs, _content = cover
+    engine = IncrementalForward(
+        config, tasks, SpillCache(budget_bytes=2**30),
+        ledger=FacetDeltaLedger(),
+    )
+    engine.record(sgs)
+    return engine
+
+
+def _fresh_stream(config, tasks, sgs):
+    """An independent full stream of ``tasks`` — the ground truth."""
+    ref = SpillCache(budget_bytes=2**30)
+    fwd = StreamedForward(config, tasks, residency="device")
+    for _ in fwd.stream_column_groups(sgs, spill=ref):
+        pass
+    assert ref.complete
+    return ref
+
+
+def _max_rel_diff(spill, ref):
+    mx = sc = 0.0
+    assert len(spill) == len(ref)
+    for k in range(len(spill)):
+        a, b = np.asarray(spill.get(k)), np.asarray(ref.get(k))
+        mx = max(mx, float(np.max(np.abs(a - b))))
+        sc = max(sc, float(np.max(np.abs(b))))
+    return mx / (sc or 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Patch correctness + exactness ladder
+# ---------------------------------------------------------------------------
+
+
+def test_patch_matches_full_recompute(cover):
+    config, tasks, sgs, content = cover
+    engine = _engine(cover)
+    v0 = engine.ledger.version
+    assert engine.spill.stream_version == v0
+
+    for kk, scale in ((1, 1.75), (2, 0.6)):
+        new = _mutate(engine.facet_tasks, content[:kk], scale)
+        report = engine.update(new)
+        assert report["mode"] == "patch", report
+        assert report["changed_facets"] == content[:kk]
+        assert report["patched_columns"] >= 1
+        assert report["patched_entries"] >= 1
+        assert report["stream_version"] == engine.ledger.version
+        assert engine.spill.stream_version == engine.ledger.version
+        ref = _fresh_stream(config, engine.facet_tasks, sgs)
+        assert _max_rel_diff(engine.spill, ref) <= REL_TOL
+    assert engine.ledger.version == v0 + 2
+    assert engine.spill.counters["patches"] >= 1
+
+
+def test_noop_and_exact_updates(cover):
+    config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    v0 = engine.ledger.version
+
+    # identical stack (fresh descriptor objects): content hash says
+    # nothing changed — no version bump, no work
+    same = _mutate(engine.facet_tasks, content[:1], 1.0)
+    report = engine.update(same)
+    assert report["mode"] == "noop"
+    assert report["reason"] == "no_facets_changed"
+    assert engine.ledger.version == v0
+
+    # exact mode: full replay, BIT-identical to an independent stream
+    new = _mutate(engine.facet_tasks, content[:1], 3.0)
+    report = engine.update(new, exact=True)
+    assert report["mode"] == "replay"
+    assert report["reason"] == "exact_mode"
+    ref = _fresh_stream(config, engine.facet_tasks, sgs)
+    for k in range(len(engine.spill)):
+        np.testing.assert_array_equal(
+            np.asarray(engine.spill.get(k)), np.asarray(ref.get(k))
+        )
+
+
+def test_exact_env_var_forces_replay(cover, monkeypatch):
+    _config, _tasks, _sgs, content = cover
+    engine = _engine(cover)
+    monkeypatch.setenv("SWIFTLY_DELTA_EXACT", "1")
+    report = engine.update(_mutate(engine.facet_tasks, content[:1], 2.2))
+    assert report["mode"] == "replay"
+    assert report["reason"] == "exact_mode"
+
+
+def test_update_before_record_raises(cover):
+    config, tasks, _sgs, _content = cover
+    engine = IncrementalForward(
+        config, tasks, SpillCache(budget_bytes=2**30)
+    )
+    with pytest.raises(ValueError, match="record"):
+        engine.update(tasks)
+
+
+# ---------------------------------------------------------------------------
+# Ledger semantics
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_commit_idempotent_and_change_detection():
+    a = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+    b = np.ones((3, 4), np.float32)
+    ledger = FacetDeltaLedger()
+    assert ledger.version == 0
+    assert ledger.n_facets is None
+    assert ledger.commit([(None, a), (None, b)]) == 1
+    # committing IDENTICAL CONTENT (a fresh copy) is a no-op
+    assert ledger.commit([(None, a.copy()), (None, b.copy())]) == 1
+    assert ledger.changed([(None, a), (None, b)]) == []
+    a2 = a.copy()
+    a2[1, 2] += 1e-3  # one-pixel change hashes different
+    assert ledger.changed([(None, a2), (None, b)]) == [0]
+    assert ledger.commit([(None, a2), (None, b)]) == 2
+    assert ledger.n_facets == 2
+    assert ledger.as_dict() == {"version": 2, "n_facets": 2}
+
+
+def test_ledger_edge_cases():
+    a = np.ones((2, 2), np.float32)
+    ledger = FacetDeltaLedger()
+    with pytest.raises(ValueError, match="no committed facet stack"):
+        ledger.changed([(None, a)])
+    ledger.commit([(None, a)])
+    with pytest.raises(ValueError, match="facet count changed"):
+        ledger.changed([(None, a), (None, a)])
+    # lazy tasks are materialised for hashing (the StreamedForward
+    # contract): a callable returning the same content hashes equal
+    assert facet_hash(lambda: a.copy()) == facet_hash(a)
+    # dtype is part of the content identity
+    assert facet_hash(a) != facet_hash(a.astype(np.float64))
+
+
+def test_ledger_empty_sparse_facet_scaling_is_no_change():
+    empty = SparseRealFacet(
+        64,
+        np.array([], dtype=np.int64),
+        np.array([], dtype=np.int64),
+        np.array([], dtype=np.float32),
+    )
+    scaled = SparseRealFacet(
+        64, empty.rows, empty.cols,
+        np.asarray(empty.vals) * np.float32(7.0),
+    )
+    ledger = FacetDeltaLedger()
+    ledger.commit([(None, empty)])
+    # zero pixels scaled by anything is the SAME content — the ledger
+    # must not invalidate a valid cache for it
+    assert ledger.changed([(None, scaled)]) == []
+
+
+def test_facet_delta_shapes_and_sparse_exactness():
+    old = SparseRealFacet(
+        32, np.array([1, 3]), np.array([2, 2]),
+        np.array([1.0, 2.0], np.float32),
+    )
+    new = SparseRealFacet(
+        32, np.array([1, 5]), np.array([2, 9]),
+        np.array([4.0, 0.5], np.float32),
+    )
+    d = facet_delta(old, new)
+    # the sparse delta densifies to exactly new - old (duplicate
+    # coordinates accumulate in both paths)
+    np.testing.assert_array_equal(
+        d.densify(), new.densify() - old.densify()
+    )
+    with pytest.raises(ValueError, match="size changed"):
+        facet_delta(old, SparseRealFacet(
+            64, new.rows, new.cols, new.vals
+        ))
+    with pytest.raises(ValueError, match="shape changed"):
+        facet_delta(np.ones((2, 2)), np.ones((3, 3)))
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder: patch -> replay
+# ---------------------------------------------------------------------------
+
+
+def test_patch_failure_degrades_to_replay(cover, monkeypatch):
+    from swiftly_tpu.resilience import degrade, faults
+
+    config, _tasks, sgs, content = cover
+    monkeypatch.setenv("SWIFTLY_RETRY_MAX", "1")
+    engine = _engine(cover)
+    degrade.reset()
+    new = _mutate(engine.facet_tasks, content[:1], 2.5)
+    plan = faults.FaultPlan(
+        [{"site": "spill.write", "kind": "ioerror", "every": 1}]
+    )
+    with faults.active(plan):
+        report = engine.update(new)
+    # every patch write failed past its retries -> the ladder lands on
+    # the full replay (which streams RAM entries, no spill.write site)
+    assert report["mode"] == "replay"
+    assert report["reason"] == "patch_failed"
+    assert any(
+        e["site"] == "delta" and e["action"] == "patch_to_replay"
+        for e in degrade.events()
+    )
+    assert plan.injected, "the drill must actually have injected"
+    # slower, never wrong: bit-identical to an independent fresh stream
+    ref = _fresh_stream(config, engine.facet_tasks, sgs)
+    for k in range(len(engine.spill)):
+        np.testing.assert_array_equal(
+            np.asarray(engine.spill.get(k)), np.asarray(ref.get(k))
+        )
+
+
+# ---------------------------------------------------------------------------
+# Version pinning: feeds and the serve path
+# ---------------------------------------------------------------------------
+
+
+def test_stale_feed_refuses_after_update(cover):
+    _config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    feed = engine.feed()
+    assert feed.lookup(sgs[0]) is not None
+    engine.update(_mutate(engine.facet_tasks, content[:1], 1.4))
+    with pytest.raises(LookupError, match="stream version moved"):
+        feed.lookup(sgs[0])
+    assert feed.stale == 1
+    # a feed rebuilt AFTER the update serves the patched rows
+    feed2 = engine.feed()
+    assert feed2.stream_version == engine.spill.stream_version
+    assert feed2.lookup(sgs[0]) is not None
+
+
+def test_serve_version_pinning_after_facet_update(cover):
+    from swiftly_tpu.serve import SubgridService
+
+    config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    dense = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+    svc = SubgridService(
+        SwiftlyForward(config, dense), cache_feed=engine.feed()
+    )
+    sg = sgs[0]
+    pre = svc.serve([sg])
+    assert pre[0].result.ok and pre[0].result.path == "cache"
+    pre_row = np.array(pre[0].result.data)
+
+    # an in-flight request admitted at the OLD version drains at that
+    # version before any row moves
+    inflight = svc.submit(sg)
+    new = _mutate(engine.facet_tasks, content[:1], 2.0)
+    report = svc.post_facet_update(engine, new)
+    assert report["mode"] == "patch"
+    assert inflight.result is not None and inflight.result.ok
+    np.testing.assert_array_equal(
+        np.asarray(inflight.result.data), pre_row
+    )
+    stats = svc.stats()
+    assert stats["facet_updates"] == 1
+    assert stats["stream_version"] == engine.ledger.version
+
+    # post-update: the served row is the PATCHED row — equal to a
+    # fresh full recompute of the new stack, never the pre-update row
+    post = svc.serve([sg])
+    assert post[0].result.ok and post[0].result.path == "cache"
+    post_row = np.asarray(post[0].result.data)
+    assert not np.array_equal(post_row, pre_row)
+    ref = _fresh_stream(config, engine.facet_tasks, sgs)
+    ref_row = None
+    for k in range(len(ref)):
+        for c, col in enumerate(ref.meta(k)):
+            for s, (_i, cfg) in enumerate(col):
+                if (cfg.off0, cfg.off1) == (sg.off0, sg.off1):
+                    ref_row = np.asarray(ref.get_row(k, (c, s)))
+    assert ref_row is not None
+    scale = float(np.max(np.abs(ref_row))) or 1.0
+    assert float(np.max(np.abs(post_row - ref_row))) <= REL_TOL * scale
+
+
+def test_serve_version_mismatch_falls_back_to_compute(cover):
+    from swiftly_tpu.serve import SubgridService
+
+    config, _tasks, sgs, _content = cover
+    engine = _engine(cover)
+    dense = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+    svc = SubgridService(
+        SwiftlyForward(config, dense), cache_feed=engine.feed()
+    )
+    # a request stamped with a version the feed does not carry must
+    # NEVER see cached rows — belt and braces under the feed's own gate
+    req = svc.submit(sgs[0])
+    req.stream_version = 99
+    svc.pump_once()
+    assert req.result is not None and req.result.ok
+    assert req.result.path != "cache"
+    assert svc.stats()["version_fallbacks"] == 1
+
+
+def test_sparse_cover_columns_shed_outside_cover(cover):
+    from swiftly_tpu.serve import STATUS_SHED, SubgridService
+
+    config, _tasks, sgs, _content = cover
+    engine = _engine(cover)
+    dense = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+    off0s = sorted({sg.off0 for sg in sgs})
+    covered = off0s[: max(1, int(len(off0s) * 0.6))]  # a 60%-FoV cover
+    svc = SubgridService(
+        SwiftlyForward(config, dense), cache_feed=engine.feed(),
+        cover_columns=covered,
+    )
+    inside = [sg for sg in sgs if sg.off0 == covered[0]][:2]
+    outside = [sg for sg in sgs if sg.off0 not in set(covered)][:2]
+    assert inside and outside
+
+    good = svc.serve(inside)
+    for r in good:
+        assert r.result is not None and r.result.ok, r.result
+    for sg in outside:
+        req = svc.submit(sg)  # shed at the door: completed already
+        assert req.result is not None
+        assert req.result.status == STATUS_SHED
+        assert req.result.shed_reason == "outside_cover"
+    stats = svc.stats()
+    assert stats["n_shed"] == len(outside)
+    assert stats["shed_reasons"]["outside_cover"] == len(outside)
+
+
+# ---------------------------------------------------------------------------
+# Planning: break-even pricing
+# ---------------------------------------------------------------------------
+
+
+def test_plan_delta_break_even_monotone():
+    from swiftly_tpu.plan import PlanInputs, plan_delta
+
+    inputs = PlanInputs.from_config(TEST_NAME)
+    n = int(inputs.n_facets)
+    assert n >= 2
+    p1 = plan_delta(inputs, 1)
+    assert p1.mode == "patch"
+    assert p1.predicted_wall_s < p1.full_wall_s
+    pn = plan_delta(inputs, n)
+    assert pn.mode == "full"  # K == J can never beat the full forward
+    assert 1 < p1.break_even_k <= n + 1
+    assert p1.break_even_k == pn.break_even_k
+    # the K sweep is monotone: patching more facets never gets cheaper
+    walls = [
+        plan_delta(inputs, k).predicted_wall_s for k in range(1, n + 1)
+    ]
+    assert walls == sorted(walls)
+    d = p1.as_dict()
+    assert d["mode"] == "patch" and d["changed_facets"] == 1
+    assert any(a["mode"] == "full" for a in d["alternatives"])
+    assert "break-even" in p1.explain()
+    with pytest.raises(ValueError, match="changed_facets"):
+        plan_delta(inputs, 0)
+    with pytest.raises(ValueError, match="changed_facets"):
+        plan_delta(inputs, n + 1)
+
+
+def test_engine_report_carries_plan(cover):
+    _config, _tasks, _sgs, content = cover
+    engine = _engine(cover)
+    report = engine.update(
+        _mutate(engine.facet_tasks, content[:1], 1.1)
+    )
+    plan = report["plan"]
+    assert plan is not None and plan["mode"] == "patch"
+    assert plan["changed_facets"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint meta carries the stream version
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_meta_stream_version(cover, tmp_path):
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.utils.checkpoint import (
+        save_streamed_backward_state,
+    )
+
+    config, _tasks, _sgs, _content = cover
+    facet_configs = make_full_facet_cover(config)
+
+    def saved_meta(bwd, path):
+        save_streamed_backward_state(path, bwd, [])
+        with np.load(path) as data:
+            return json.loads(bytes(data["meta"].tobytes()).decode())
+
+    bwd = StreamedBackward(config, facet_configs, residency="device")
+    # unversioned sessions stamp 0 (absent tolerated on restore)
+    assert saved_meta(bwd, tmp_path / "ck0.npz")["stream_version"] == 0
+    bwd.stream_version = 5  # e.g. adopted from a FacetDeltaLedger
+    assert saved_meta(bwd, tmp_path / "ck5.npz")["stream_version"] == 5
+
+
+# ---------------------------------------------------------------------------
+# The 32k acceptance drill (slow; tier-1 runs the 1k cover above)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_delta_drill_32k_speedup(tmp_path):
+    """ROADMAP 5(b) acceptance: at 32k a K=1 facet update lands >= 4x
+    faster than the full re-record, within tolerance."""
+    out = tmp_path / "BENCH_delta_32k.json"
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "delta_drill.py"),
+         "--config", "32k[1]-n8k-512", "--k", "1",
+         "--out", str(out)],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=3600,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    record = json.loads(out.read_text())
+    delta = record["delta"]
+    assert delta["match"]["within_tolerance"] is True
+    assert delta["speedup_vs_full"] >= 4.0
