@@ -481,6 +481,31 @@ class SwiftlyForward:
         self.lru = LRUCache(lru_forward)
         self.queue = FlightQueue(queue_size)
 
+    def adopt_facet_tasks(self, facet_tasks):
+        """Swap in a new facet stack: drops the prepared facet planes
+        and the column LRU, and rebuilds the stack descriptors, so
+        every later subgrid computes from the new data. The serve
+        path's update hook (`serve.SubgridService.post_facet_update`)
+        calls this so its compute fallback — feed misses, evicted rows,
+        stale feeds — never serves a superseded stack. Callables are
+        materialised and sparse descriptors densified, matching the
+        constructor's expectations."""
+        data = []
+        for _, d in facet_tasks:
+            d = d() if callable(d) else d
+            if hasattr(d, "densify"):
+                d = d.densify()
+            data.append(d)
+        self.stack = _FacetStack(
+            [cfg for cfg, _ in facet_tasks], pad_to=_mesh_size(self.mesh)
+        )
+        self._facet_data = data
+        self._BF_Fs = None
+        self._offs0 = _place(self.core, self.mesh, self.stack.offs0, True)
+        self._offs1 = _place(self.core, self.mesh, self.stack.offs1, True)
+        self.lru = LRUCache(self.lru.capacity)
+        return self
+
     def _get_BF_Fs(self):
         if self._BF_Fs is None:
             with _metrics.stage("fwd.prepare_facets") as st:

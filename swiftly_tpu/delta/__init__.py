@@ -17,11 +17,12 @@ See docs/incremental.md; `plan.plan_delta` prices the break-even and
 """
 
 from .engine import IncrementalForward, facet_delta
-from .ledger import FacetDeltaLedger, facet_hash
+from .ledger import FacetDeltaLedger, config_hash, facet_hash
 
 __all__ = [
     "FacetDeltaLedger",
     "IncrementalForward",
+    "config_hash",
     "facet_delta",
     "facet_hash",
 ]

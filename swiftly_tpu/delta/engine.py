@@ -35,7 +35,17 @@ patch path — a delta-stream error, an unmappable row, a patch write
 that stays failed past its retries — degrades to a full re-record of
 the stream with the new stack (``delta.patch_to_replay`` in the
 degradation ledger). Slower, never wrong; a partially-patched cache is
-impossible to observe because the replay re-fills every entry.
+impossible to observe: the replay re-fills every entry, and for the
+whole rewrite window (first ``patch_entry`` through the version
+re-stamp, and the replay's reset-to-refill) the cache is marked
+mid-patch (`utils.spill.SpillCache.begin_patch`), so a CONCURRENT
+consumer — a live `CachedColumnFeed` on a serving replica — gets
+LookupError and falls back to compute at its pinned version instead of
+reading a torn mix of old and new rows. A facet whose `FacetConfig`
+(geometry/masks) changed is NOT treated as a data delta — the
+facet→subgrid map depends on the config, so the engine replays
+(``facet_config_changed``) rather than pairing the old config with a
+data diff.
 
 Break-even: `plan.plan_delta` prices the incremental path against the
 full recompute from the same stage coefficients; ``update`` honours
@@ -190,6 +200,12 @@ class IncrementalForward:
         reason = None
         if exact:
             reason = "exact_mode"
+        elif self.ledger.config_changed(tasks):
+            # a config change is not a data delta: the facet->subgrid
+            # map depends on the geometry/masks, so pairing the old
+            # config with a data diff would silently mis-stream the
+            # correction — replay with the new stack instead
+            reason = "facet_config_changed"
         elif not self.spill.complete:
             reason = "incomplete_cache"
         elif len(changed) >= len(tasks):
@@ -202,8 +218,21 @@ class IncrementalForward:
             corrections, patched_columns = self._stream_delta(
                 tasks, changed
             )
-            for k in sorted(corrections):
-                self.spill.patch_entry(k, corrections[k])
+            # live feeds refuse lookups from the first patched entry
+            # until the bumped version is stamped (begin_patch /
+            # end_patch): a consumer racing the patch — a serving
+            # replica's CachedColumnFeed — can never return a mix of
+            # old and new rows; it falls back to compute at the
+            # version its request was admitted under
+            self.spill.begin_patch()
+            try:
+                for k in sorted(corrections):
+                    self.spill.patch_entry(k, corrections[k])
+                self._adopt(tasks)
+                self.ledger.commit(self.facet_tasks)
+                self.ledger.stamp(self.spill)
+            finally:
+                self.spill.end_patch()
         except Exception as exc:  # noqa: BLE001 - the degradation ladder
             # rung: patch -> replay. A torn patch (some entries updated,
             # some not) is unobservable: the replay re-fills every entry
@@ -221,9 +250,6 @@ class IncrementalForward:
             return self._replay(
                 tasks, changed, "patch_failed", plan_dict
             )
-        self._adopt(tasks)
-        self.ledger.commit(self.facet_tasks)
-        self.ledger.stamp(self.spill)
         _metrics.count("delta.patches")
         _metrics.count("delta.patched_entries", len(corrections))
         _trace.instant("delta.patch", cat="delta",
@@ -300,15 +326,32 @@ class IncrementalForward:
 
     def _replay(self, tasks, changed, reason, plan_dict):
         """Full re-record with the new stack — the exact path and the
-        ladder's landing zone. Bit-identical to a fresh forward."""
+        ladder's landing zone. Bit-identical to a fresh forward. Live
+        feeds refuse lookups for the whole reset-to-refill window
+        (``begin_patch`` plus the cache's own ``complete`` gate), and a
+        refill that overflows the budget raises BEFORE the ledger
+        commits — mirroring `record`'s check — so a half-recorded
+        stream is never reported as a successful replay."""
         self._adopt(tasks)
-        self.spill.reset()
-        for _ in self.fwd.stream_column_groups(
-            self._subgrid_configs, spill=self.spill
-        ):
-            pass
-        self.ledger.commit(self.facet_tasks)
-        self.ledger.stamp(self.spill)
+        self.spill.begin_patch()
+        try:
+            self.spill.reset()
+            for _ in self.fwd.stream_column_groups(
+                self._subgrid_configs, spill=self.spill
+            ):
+                pass
+            if not self.spill.complete:
+                raise RuntimeError(
+                    "the replay stream did not fit the spill cache "
+                    "(fill gave up); the recorded stream is incomplete "
+                    "and feeds fall back to compute — raise "
+                    "SWIFTLY_SPILL_BUDGET_GB or set SWIFTLY_SPILL_DIR, "
+                    "then record() again"
+                )
+            self.ledger.commit(self.facet_tasks)
+            self.ledger.stamp(self.spill)
+        finally:
+            self.spill.end_patch()
         _metrics.count("delta.replays")
         _trace.instant("delta.replay", cat="delta", reason=reason,
                        version=self.ledger.version)

@@ -2205,9 +2205,24 @@ class CachedColumnFeed:
 
     def lookup(self, config):
         """The recorded host row for ``config``, or None on a miss;
-        raises LookupError when the index hit an evicted entry or the
+        raises LookupError when the index hit an evicted entry, the
         cache's stream version moved since this feed was built (a
-        facet update patched the rows — this feed is stale)."""
+        facet update patched the rows — this feed is stale), or the
+        cache is mid-rewrite (``patching`` set by
+        `utils.spill.SpillCache.begin_patch`, or ``complete`` dropped
+        by a replay's refill) — a partially-patched stream must never
+        serve, even to a concurrent reader that races the patcher."""
+        if getattr(self._spill, "patching", False) or not getattr(
+            self._spill, "complete", False
+        ):
+            self.stale += 1
+            if _metrics.enabled():
+                _metrics.count("spill.feed_stale")
+            raise LookupError(
+                "cached stream is mid-update (a facet patch or replay "
+                "is rewriting its entries); fall back to compute and "
+                "rebuild the feed once the update lands"
+            )
         current = int(getattr(self._spill, "stream_version", 0))
         if current != self.stream_version:
             self.stale += 1

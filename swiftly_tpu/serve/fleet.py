@@ -880,18 +880,26 @@ class ServeFleet:
         """Fleet-wide incremental facet update: run the
         `delta.IncrementalForward` update ONCE (one delta stream + one
         cache patch, or its degradation ladder), then propagate the
-        patched feed and the new stream version to every replica's
-        service. Each replica drains its own in-flight requests before
-        adopting the feed, so version pinning holds per replica; there
+        patched feed, the new stream version AND the new facet stack to
+        every replica's service. Replica pumps keep serving while the
+        engine patches: the spill cache is marked mid-patch for the
+        whole rewrite window (`utils.spill.SpillCache.begin_patch`), so
+        a live feed's lookups raise and requests fall back to compute
+        at the version they were admitted under — a partially-patched
+        row can never serve. Each replica then drains its own in-flight
+        requests before adopting the feed and rebuilding its forward
+        over the new stack, so version pinning holds per replica; there
         is no fleet-wide stop-the-world and no cache flush.
         """
         report = engine.update(new_facet_tasks, **update_kw)
         for replica in self._replicas.values():
             # a fresh feed per replica: feeds carry per-feed stale/hit
             # state and the captured version, so replicas must not
-            # share one object
+            # share one object — and each replica adopts the new stack
+            # into ITS OWN forward (forwards are per-pump-thread state)
             replica.service.post_facet_update(
-                report=report, feed=engine.feed()
+                report=report, feed=engine.feed(),
+                new_facet_tasks=engine.facet_tasks,
             )
         self._counts["facet_updates"] = (
             self._counts.get("facet_updates", 0) + 1

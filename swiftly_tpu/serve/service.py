@@ -588,9 +588,10 @@ class SubgridService:
         * ``post_facet_update(engine, new_facet_tasks)`` — run the
           `delta.IncrementalForward` update here (delta-stream + cache
           patch, or its degradation ladder) and adopt its feed;
-        * ``post_facet_update(report=..., feed=..., fwd=...)`` — adopt
-          a pre-computed update (the fleet runs ``engine.update`` ONCE
-          and propagates the result to every replica this way).
+        * ``post_facet_update(report=..., feed=..., fwd=...,
+          new_facet_tasks=...)`` — adopt a pre-computed update (the
+          fleet runs ``engine.update`` ONCE and propagates the result
+          to every replica this way).
 
         In-flight requests are pinned to the version they were admitted
         under: the queue is DRAINED before the cache rows move, so
@@ -598,6 +599,14 @@ class SubgridService:
         admitted to; requests submitted after this returns carry the
         new version and are served from the patched rows. No cache
         flush — the feed swap is the only serving-path change.
+
+        The compute FALLBACK moves with the update too: the forward is
+        rebuilt over the new stack (an explicit ``fwd=``, or
+        ``self.fwd.adopt_facet_tasks`` over the engine's adopted /
+        passed ``new_facet_tasks``), so a new-version request that
+        misses the feed — a config outside the recorded cover, an
+        evicted disk entry, a stale-feed LookupError — is computed
+        against the NEW facet data, never silently served stale.
         """
         if engine is None and report is None:
             raise ValueError(
@@ -614,8 +623,20 @@ class SubgridService:
                 report = engine.update(new_facet_tasks, **update_kw)
                 if feed is None:
                     feed = engine.feed()
+                if new_facet_tasks is None:
+                    new_facet_tasks = engine.facet_tasks
             if fwd is not None:
                 self.fwd = fwd
+            elif report.get("mode") != "noop" and new_facet_tasks is not None:
+                if hasattr(self.fwd, "adopt_facet_tasks"):
+                    self.fwd.adopt_facet_tasks(new_facet_tasks)
+                else:
+                    log.warning(
+                        "forward %s cannot adopt the new facet stack "
+                        "(no adopt_facet_tasks); compute fallbacks "
+                        "would serve the superseded stack — pass fwd= "
+                        "explicitly", type(self.fwd).__name__,
+                    )
             if feed is not None and self.cache_feed is not None:
                 self.cache_feed = feed
             self.stream_version = int(

@@ -119,6 +119,10 @@ class SpillCache:
         # refuse rows once it moves — a patched stream can never serve
         # through a feed indexed before the patch.
         self.stream_version = 0
+        # True while a patcher rewrites entries (begin_patch/end_patch);
+        # feeds refuse lookups for the whole window, so a concurrent
+        # reader can never observe a partially-patched stream
+        self.patching = False
         self.counters = {
             "writes": 0,
             "evictions": 0,
@@ -273,13 +277,31 @@ class SpillCache:
 
     # -- patch --------------------------------------------------------------
 
+    def begin_patch(self):
+        """Mark the cache mid-patch: `parallel.streamed.CachedColumnFeed`
+        refuses lookups while the mark is set, so a live feed can never
+        observe a partially-patched stream — its consumers fall back to
+        compute at their pinned version. The patcher clears the mark
+        with `end_patch` AFTER re-stamping ``stream_version``, so there
+        is no window in which a superseded feed serves."""
+        self.patching = True
+        _trace.instant("spill.begin_patch", cat="spill")
+
+    def end_patch(self):
+        """Clear the mid-patch mark (see `begin_patch`)."""
+        self.patching = False
+        _trace.instant("spill.end_patch", cat="spill")
+
     def patch_entry(self, k, delta):
         """Add ``delta`` into entry k — the incremental engine's cache
         patch (`delta.IncrementalForward`).
 
-        Atomic per entry: a RAM entry is one vectorised in-place add
-        (behind the ``spill.write`` fault site, BEFORE the add, so a
-        retried injection can never double-apply); a disk entry is
+        Atomic AND idempotent per entry: a RAM entry is patched out of
+        place (the retried closure only reads the old array, computes
+        ``base + delta`` fresh and swaps the entry reference, so a
+        transient failure at ANY point — even after a partial
+        application would have happened in place — retries from the
+        unmodified base and can never double-apply); a disk entry is
         read, added, and rewritten through the same tmp-sibling +
         rename path as the fill — a crash mid-patch leaves the old
         entry intact, never a torn one. A failure that outlives the
@@ -296,17 +318,14 @@ class SpillCache:
             )
         add = delta.astype(base.dtype, copy=False)
         if kind == "ram":
-            if not payload.flags.writeable:
-                # recorded entries are zero-copy views of device arrays
-                # (read-only buffers); the first patch owns a writable
-                # copy — later patches add in place
-                payload = np.array(payload)
-                self._entries[k] = ("ram", payload)
 
             def write():
                 fault_point("spill.write")
                 with _metrics.stage("spill.patch") as st:
-                    np.add(payload, add, out=payload)
+                    # out of place: recomputed from the unmodified
+                    # `payload` on every retry; the entry swap is one
+                    # reference assignment, atomic for concurrent reads
+                    self._entries[k] = ("ram", payload + add)
                     st.bytes_moved = int(add.nbytes)
 
             retry_transient(write, site="spill.write")

@@ -324,9 +324,157 @@ def test_patch_failure_degrades_to_replay(cover, monkeypatch):
         )
 
 
+def test_patch_entry_retry_is_idempotent_and_out_of_place():
+    """A transient patch-write failure retries OUT OF PLACE: the closure
+    recomputes base + delta from the unmodified entry and swaps the
+    reference, so a retry can never double-apply and a concurrent
+    reader's view is never mutated under it."""
+    from swiftly_tpu.resilience import faults
+
+    spill = SpillCache(budget_bytes=2**20, spill_dir=None)
+    spill.begin_fill(tag="patch-idempotency")
+    base = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+    assert spill.put([[(0, None)]], base.copy())
+    assert spill.end_fill()
+    d = np.full((4, 4), 0.25, np.float32)
+    spill.patch_entry(0, d)
+    mid = spill._entries[0][1]  # a concurrent reader's view
+    mid_copy = np.array(mid)
+    plan = faults.FaultPlan(
+        [{"site": "spill.write", "kind": "ioerror", "at": 0}]
+    )
+    with faults.active(plan):
+        spill.patch_entry(0, d)  # fails once, retried
+    assert plan.injected, "the drill must actually have injected"
+    np.testing.assert_array_equal(mid, mid_copy)
+    np.testing.assert_array_equal(spill.get(0), base + d + d)
+
+
+def test_replay_overflow_raises_before_commit(cover):
+    """A replay whose refill overflows the budget must raise (mirroring
+    record()'s check), NOT claim success — and the destroyed stream
+    must refuse to serve through pre-update feeds."""
+    _config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    v0 = engine.ledger.version
+    feed = engine.feed()
+    engine.spill.budget_bytes = 0  # the replay can no longer fit
+    engine.spill.spill_dir = None
+    with pytest.raises(RuntimeError, match="did not fit"):
+        engine.update(
+            _mutate(engine.facet_tasks, content[:1], 2.0), exact=True
+        )
+    # no success was claimed: the ledger never committed or stamped
+    assert engine.ledger.version == v0
+    assert engine.spill.complete is False
+    assert engine.spill.patching is False
+    # and the stale feed refuses (incomplete-cache gate) instead of
+    # serving rows out of the destroyed stream
+    with pytest.raises(LookupError, match="mid-update"):
+        feed.lookup(sgs[0])
+
+
+# ---------------------------------------------------------------------------
+# Config identity: a changed FacetConfig is never a data delta
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_versions_config_changes():
+    from swiftly_tpu.delta import config_hash
+    from swiftly_tpu.models.config import FacetConfig
+
+    a = np.ones((4, 4), np.float32)
+    ledger = FacetDeltaLedger()
+    ledger.commit([(FacetConfig(0, 0, 4), a)])
+    # identical config + identical data content: nothing changed
+    assert ledger.changed([(FacetConfig(0, 0, 4), a.copy())]) == []
+    assert ledger.config_changed([(FacetConfig(0, 0, 4), a)]) == []
+    # config-only change (same data, moved offset): reported by both
+    # changed() and config_changed(), and commit bumps the version —
+    # the recorded stream is stale either way
+    moved = FacetConfig(8, 0, 4)
+    assert ledger.changed([(moved, a)]) == [0]
+    assert ledger.config_changed([(moved, a)]) == [0]
+    v = ledger.version
+    assert ledger.commit([(moved, a)]) == v + 1
+    # masks are identity-relevant; their realisation is not (a slice
+    # list and its realised array hash equal — no spurious invalidation)
+    sl = FacetConfig(0, 0, 4, mask0=[[slice(1, 3)], 4])
+    realised = FacetConfig(0, 0, 4, mask0=np.asarray(sl.mask0).copy())
+    assert config_hash(sl) == config_hash(realised)
+    flipped = np.asarray(sl.mask0).copy()
+    flipped[0] = 1 - flipped[0]
+    assert config_hash(
+        FacetConfig(0, 0, 4, mask0=flipped)
+    ) != config_hash(sl)
+
+
+def test_engine_replays_on_config_change(cover):
+    """A facet whose CONFIG changed under identical data must replay —
+    pairing the old config with a data diff would silently mis-stream
+    the correction (the facet->subgrid map depends on the config)."""
+    from swiftly_tpu.models.config import FacetConfig
+
+    config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    j = content[0]
+    fc, data = engine.facet_tasks[j]
+    m = np.asarray(fc.mask0).copy()
+    m[: len(m) // 4] = 0.0  # shrink the ownership window; data intact
+    new = list(engine.facet_tasks)
+    new[j] = (
+        FacetConfig(fc.off0, fc.off1, fc.size, mask0=m, mask1=fc._mask1),
+        data,
+    )
+    report = engine.update(new)
+    assert report["mode"] == "replay"
+    assert report["reason"] == "facet_config_changed"
+    assert j in report["changed_facets"]
+    # the replay is a full re-record with the new cover: bit-identical
+    # to an independent fresh stream of the same tasks
+    ref = _fresh_stream(config, engine.facet_tasks, sgs)
+    for k in range(len(engine.spill)):
+        np.testing.assert_array_equal(
+            np.asarray(engine.spill.get(k)), np.asarray(ref.get(k))
+        )
+
+
 # ---------------------------------------------------------------------------
 # Version pinning: feeds and the serve path
 # ---------------------------------------------------------------------------
+
+
+def test_feed_refuses_mid_patch(cover, monkeypatch):
+    """The concurrency contract: from the first patched entry to the
+    version re-stamp the cache is marked mid-patch, so a live feed —
+    e.g. a serving replica racing the patcher — raises LookupError
+    instead of returning a partially-patched mix of rows."""
+    _config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    feed = engine.feed()
+    assert feed.lookup(sgs[0]) is not None
+    observed = {"patches": 0, "refused": 0}
+    orig = SpillCache.patch_entry
+
+    def guarded(self, k, delta):
+        observed["patches"] += 1
+        assert self.patching, "patch_entry must run inside begin_patch"
+        with pytest.raises(LookupError, match="mid-update"):
+            feed.lookup(sgs[0])
+        observed["refused"] += 1
+        return orig(self, k, delta)
+
+    monkeypatch.setattr(SpillCache, "patch_entry", guarded)
+    report = engine.update(_mutate(engine.facet_tasks, content[:1], 1.3))
+    assert report["mode"] == "patch"
+    assert observed["patches"] >= 1
+    assert observed["refused"] == observed["patches"]
+    assert engine.spill.patching is False
+    # post-update the pre-patch feed refuses via the version gate...
+    with pytest.raises(LookupError, match="stream version moved"):
+        feed.lookup(sgs[0])
+    # ...and a rebuilt feed serves the patched rows
+    assert engine.feed().lookup(sgs[0]) is not None
 
 
 def test_stale_feed_refuses_after_update(cover):
@@ -388,6 +536,74 @@ def test_serve_version_pinning_after_facet_update(cover):
     assert ref_row is not None
     scale = float(np.max(np.abs(ref_row))) or 1.0
     assert float(np.max(np.abs(post_row - ref_row))) <= REL_TOL * scale
+
+
+def test_service_compute_fallback_serves_new_stack_after_update(cover):
+    """After post_facet_update the compute FALLBACK moves too: the
+    service forward is rebuilt over the engine's adopted stack, so a
+    new-version request that cannot use the feed is computed against
+    the NEW facet data — never a silently stale result."""
+    from swiftly_tpu.serve import SubgridService
+
+    config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+    dense = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+    svc = SubgridService(
+        SwiftlyForward(config, dense), cache_feed=engine.feed()
+    )
+    sg = sgs[0]
+    old_row = np.array(np.asarray(svc.serve([sg])[0].result.data))
+    new = _mutate(engine.facet_tasks, content[:1], 2.0)
+    report = svc.post_facet_update(engine, new)
+    assert report["mode"] == "patch"
+    # force the compute path (version mismatch -> never the cache)
+    req = svc.submit(sg)
+    req.stream_version = 99
+    svc.pump_once()
+    assert req.result is not None and req.result.ok
+    assert req.result.path != "cache"
+    got = np.asarray(req.result.data)
+    dense_new = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+    ref = np.asarray(
+        SwiftlyForward(config, dense_new).get_subgrid_task(sg)
+    )
+    np.testing.assert_array_equal(got, ref)
+    assert not np.array_equal(got, old_row)
+
+
+def test_fleet_post_facet_update_rolls_every_replica(cover):
+    """The fleet rollout hands every replica the new stream version, a
+    FRESH feed and a forward rebuilt over the new stack (forwards are
+    per-replica state — never shared, never left stale)."""
+    from swiftly_tpu.serve import ServeFleet, SubgridService
+
+    config, _tasks, sgs, content = cover
+    engine = _engine(cover)
+
+    def factory(_rid):
+        dense = [(fc, f.densify()) for fc, f in engine.facet_tasks]
+        return SubgridService(
+            SwiftlyForward(config, dense), cache_feed=engine.feed()
+        )
+
+    fleet = ServeFleet(factory, n_replicas=2)
+    new = _mutate(engine.facet_tasks, content[:1], 2.0)
+    report = fleet.post_facet_update(engine, new)
+    assert report["mode"] == "patch"
+    j = content[0]
+    expected = np.asarray(engine.facet_tasks[j][1].densify())
+    feeds = set()
+    for replica in fleet.replicas.values():
+        svc = replica.service
+        assert svc.stream_version == engine.ledger.version
+        assert svc.cache_feed.stream_version == engine.ledger.version
+        feeds.add(id(svc.cache_feed))
+        np.testing.assert_array_equal(
+            np.asarray(svc.fwd._facet_data[j]), expected
+        )
+        served = svc.serve([sgs[0]])[0]
+        assert served.result.ok and served.result.path == "cache"
+    assert len(feeds) == 2  # feeds are per-replica, never shared
 
 
 def test_serve_version_mismatch_falls_back_to_compute(cover):
