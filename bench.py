@@ -3385,6 +3385,449 @@ def chaos(smoke_mode=False):
     return 0 if not problems else 1
 
 
+def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
+                         fold_group=2, max_cols=0):
+    """The elastic mesh recovery drill (`bench.py --mesh --chaos`, also
+    driven by scripts/mesh_drill.py --chaos).
+
+    1. Run the facet-partitioned mesh-streamed round trip UNDISTURBED
+       over N virtual shards (pass 1 records the subgrid stream into
+       the spill cache, pass 2 is cache-fed) — the reference facets,
+       with NO fault plan installed.
+    2. Watchdog phase: re-run the recording briefly with an injected
+       ``mesh.psum`` latency and a small
+       ``SWIFTLY_COLLECTIVE_TIMEOUT_S`` — the stalled collective must
+       surface as a caught `CollectiveStalledError` (the silent-hang
+       class converted to a detected failure), then is discarded.
+    3. Chaos run: fresh spill, fault schedule installed — transient
+       spill-read/h2d IOErrors (retried), a ``mesh.feed`` latency
+       blip, a bit-flipped newest checkpoint generation (restore must
+       fall back a generation DURING migration), and one of the N
+       shards killed mid-pass-2 (``mesh.shard_loss`` on a CACHE-FED
+       pass — the recorded stream bytes are fixed, so recovery can be
+       exact). `mesh.recovery.run_elastic_pass` walks the ladder:
+       re-plan on N-1 survivors (priced by `plan.plan_mesh_layout`),
+       rebuild the engines, migrate the last autosave across layouts,
+       resume at the autosave group boundary.
+    4. Assert the recovered facets BIT-IDENTICAL to the undisturbed
+       mesh run (backward folds are shard-local per-facet — identical
+       math on any layout) and stamp the ``mesh.recovery`` +
+       ``resilience`` artifact blocks, including
+       ``recovery_overhead`` (disturbed/undisturbed wall ratio — the
+       scripts/bench_compare.py sentinel).
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftly_tpu import SWIFT_CONFIGS
+    from swiftly_tpu.mesh import (
+        MeshStreamedBackward,
+        MeshStreamedForward,
+        make_facet_mesh,
+        run_elastic_pass,
+    )
+    from swiftly_tpu.obs import metrics
+    from swiftly_tpu.plan import PlanInputs, compile_plan
+    from swiftly_tpu.resilience import (
+        CollectiveStalledError,
+        FaultPlan,
+        degrade,
+        faults,
+    )
+    from swiftly_tpu.utils.spill import SpillCache
+
+    n_req = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+    n_av = len(jax.devices())
+    params = dict(SWIFT_CONFIGS[config_name])
+    params.setdefault("fov", 1.0)
+    config, fwd, facet_configs, subgrid_configs, _sources = _build(
+        "planar", params, jnp.float32, streamed=True
+    )
+    if max_cols:
+        # smoke budget: stream only the first `max_cols` columns — the
+        # recovery mechanics (and the bit-identity contract, taken over
+        # the SAME truncated set on both runs) are column-count-blind
+        keep = set(sorted({sg.off0 for sg in subgrid_configs})[:max_cols])
+        subgrid_configs = [
+            sg for sg in subgrid_configs if sg.off0 in keep
+        ]
+    F = len(facet_configs)
+    n_shards = min(n_req, n_av, F)
+    if n_shards < 3:
+        raise ValueError(
+            f"mesh chaos drill needs >= 3 facet shards (one dies, >= 2 "
+            f"survive a real collective); have {n_shards}"
+        )
+    inputs = PlanInputs.from_cover(
+        config, facet_configs, subgrid_configs, n_devices=n_shards,
+        real_facets=getattr(fwd, "_facets_real", False),
+        fold_group=fold_group,
+    )
+    plan = compile_plan(inputs, mode="roundtrip-streamed")
+    mesh = make_facet_mesh(n_devices=plan.mesh.facet_shards)
+    facet_tasks = list(zip(facet_configs, fwd._facet_data))
+    mfwd = MeshStreamedForward(
+        config, facet_tasks, layout=plan.mesh, mesh=mesh
+    )
+    # deterministic column-group count: the fault schedule is indexed
+    # by site call number (same discipline as run_chaos_drill)
+    mfwd.col_group = col_group
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    n_groups = -(-n_cols // col_group)
+    if n_groups < 3:
+        raise ValueError(
+            f"mesh chaos drill needs >= 3 column groups (kill after 2 "
+            f"autosaves); {config_name} with col_group={col_group} has "
+            f"{n_groups}"
+        )
+    half = max(1, F // 2)
+    subsets = [(0, half), (half, F)] if F > 1 else [(0, F)]
+
+    work_dir = tempfile.mkdtemp(prefix="mesh_chaos_")
+    ck_paths = [
+        os.path.join(work_dir, f"ck_pass{i}.npz")
+        for i in range(len(subsets))
+    ]
+
+    def make_bwd(i0, i1, on_mesh):
+        return MeshStreamedBackward(
+            config, list(facet_configs[i0:i1]), mesh=on_mesh,
+            fold_group=fold_group,
+        )
+
+    try:
+        # --- undisturbed mesh reference (clean path, no plan) --------
+        assert faults.current() is None
+        t0 = time.time()
+        spill_ref = SpillCache(budget_bytes=2e9)
+        parts = []
+        for i0, i1 in subsets:
+            bwd = make_bwd(i0, i1, mesh)
+            for per_col, group in mfwd.stream_column_groups(
+                subgrid_configs, spill=spill_ref
+            ):
+                bwd.add_subgrid_group(
+                    [[sg for _, sg in col] for col in per_col], group
+                )
+            parts.append(np.asarray(bwd.finish()))
+        ref = np.concatenate(parts, axis=0)
+        clean_s = time.time() - t0
+
+        # --- watchdog phase: a stalled psum becomes a DETECTED loss --
+        wd_timeout = float(
+            os.environ.get("BENCH_MESH_WATCHDOG_S", "0.15")
+        )
+        stall_plan = FaultPlan(
+            faults=[
+                {"site": "mesh.psum", "kind": "latency", "at": 0,
+                 "delay_s": wd_timeout * 4},
+            ]
+        )
+        stalls_detected = 0
+        prev_knob = os.environ.get("SWIFTLY_COLLECTIVE_TIMEOUT_S")
+        os.environ["SWIFTLY_COLLECTIVE_TIMEOUT_S"] = str(wd_timeout)
+        try:
+            with faults.active(stall_plan):
+                try:
+                    for _pc, _g in mfwd.stream_column_groups(
+                        subgrid_configs, spill=SpillCache(budget_bytes=2e9)
+                    ):
+                        pass  # aborted by the first group's stalled sync
+                except CollectiveStalledError:
+                    stalls_detected = 1
+        finally:
+            if prev_knob is None:
+                os.environ.pop("SWIFTLY_COLLECTIVE_TIMEOUT_S", None)
+            else:
+                os.environ["SWIFTLY_COLLECTIVE_TIMEOUT_S"] = prev_knob
+
+        # --- the fault schedule --------------------------------------
+        # mesh.shard_loss fires once per yielded group; pass 1 (the
+        # recording) burns calls 0..n_groups-1, so call n_groups+2
+        # lands before pass-2's THIRD group — a CACHE-FED pass with two
+        # autosaved generations behind it (the newest gets bit-flipped,
+        # so generation fallback must compose with layout migration).
+        kill_at = n_groups + 2
+        if fault_plan is None:
+            fault_plan = FaultPlan(
+                faults=[
+                    {"site": "spill.read", "kind": "ioerror", "at": 1},
+                    {"site": "transfer.h2d", "kind": "ioerror", "at": 2},
+                    {"site": "mesh.feed", "kind": "latency", "at": 0,
+                     "delay_s": 0.01},
+                    {"site": "checkpoint.restore", "kind": "corrupt",
+                     "at": 0},
+                    {"site": "mesh.shard_loss", "kind": "shard_loss",
+                     "at": kill_at},
+                ],
+                seed=int(os.environ.get("BENCH_CHAOS_SEED", "20260804")),
+            )
+        degrade.reset()
+        counters0 = dict(
+            (metrics.export().get("counters") or {})
+        ) if metrics.enabled() else {}
+
+        # --- chaos run: elastic passes under the schedule ------------
+        t0 = time.time()
+        spill_chaos = SpillCache(budget_bytes=2e9)
+        parts = []
+        reports = []
+        fwd_cur = mfwd
+        with faults.active(fault_plan):
+            for idx, (i0, i1) in enumerate(subsets):
+                bwd = make_bwd(i0, i1, fwd_cur.mesh)
+                fwd_cur, bwd, rep = run_elastic_pass(
+                    fwd_cur, bwd, subgrid_configs, spill_chaos,
+                    ck_paths[idx], plan_inputs=inputs,
+                    max_recoveries=1,
+                )
+                reports.append(rep)
+                parts.append(np.asarray(bwd.finish()))
+        got = np.concatenate(parts, axis=0)
+        chaos_s = time.time() - t0
+
+        bit_identical = bool(
+            got.shape == ref.shape and np.array_equal(got, ref)
+        )
+        counters = dict(
+            (metrics.export().get("counters") or {})
+        ) if metrics.enabled() else {}
+
+        def delta(name):
+            return counters.get(name, 0) - counters0.get(name, 0)
+
+        recoveries = [i for r in reports for i in r["recoveries"]]
+        last = recoveries[-1] if recoveries else {}
+        recovery_block = {
+            "events": len(recoveries),
+            "recoveries": recoveries,
+            "shards_before": int(n_shards),
+            "shards_after": int(reports[-1]["shards_after"]),
+            "replanned": last.get("replanned"),
+            "migrated": bool(
+                any(i["migrated"] for i in recoveries)
+            ),
+            "subgrids_migrated": int(last.get("subgrids_migrated", 0)),
+            "watchdog": {
+                "timeout_s": wd_timeout,
+                "stalls_detected": stalls_detected,
+                "stall_plan": stall_plan.stats(),
+            },
+            "kill_site": "mesh.shard_loss",
+            "kill_at_call": kill_at,
+            "migrations": delta("ckpt.migrations"),
+            "checkpoint_fallbacks": delta("ckpt.fallbacks"),
+            "checkpoint_autosaves": delta("ckpt.autosaves"),
+            "recovery_wall_s": round(
+                sum(r["recovery_wall_s"] for r in reports), 4
+            ),
+            # disturbed/undisturbed wall ratio: the time-to-recover
+            # sentinel scripts/bench_compare.py trends (lower = better)
+            "recovery_overhead": round(chaos_s / clean_s, 4),
+            "bit_identical": bit_identical,
+        }
+        pstats = fault_plan.stats()
+        resilience = {
+            "plan": fault_plan.spec(),
+            "faults_injected": pstats["by_site"],
+            "faults_injected_total": pstats["total"],
+            "faults_by_kind": pstats["by_kind"],
+            "faults_survived": pstats["total"] if bit_identical else 0,
+            "retries": delta("retry.attempts"),
+            "retries_recovered": delta("retry.recovered"),
+            "degradations": degrade.events(),
+            "resume_count": len(recoveries),
+            "checkpoint_fallbacks": delta("ckpt.fallbacks"),
+            "checkpoint_autosaves": delta("ckpt.autosaves"),
+            "checkpoint_saves": delta("ckpt.saves"),
+            "kill_site": "mesh.shard_loss",
+            "kill_at_call": kill_at,
+            "bit_identical": bit_identical,
+        }
+        mesh_block = {
+            "n_devices": int(n_av),
+            "facet_shards": int(n_shards),
+            "n_facets": F,
+            "padded_facets": int(mfwd.stack.n_total),
+            "collective_bytes": int(plan.mesh.collective_bytes_total),
+            "clean_wall_s": round(clean_s, 4),
+            "chaos_wall_s": round(chaos_s, 4),
+            # the chaos drill's match audit IS the bit-identity
+            # contract: zero tolerance, the recovered stream must equal
+            # the undisturbed mesh run byte for byte
+            "match": {
+                "max_abs_diff": float(np.max(np.abs(got - ref))),
+                "tolerance": 0.0,
+                "within_tolerance": bit_identical,
+                "bit_identical": bit_identical,
+            },
+            "spill": spill_chaos.stats(),
+            "recovery": recovery_block,
+        }
+        platform = jax.devices()[0].platform
+        return {
+            "metric": f"{config_name} mesh chaos drill wall-clock "
+                      f"({n_shards} shards kill one mid-stream, "
+                      f"planar f32, mesh-chaos, {platform})",
+            "value": round(chaos_s, 2),
+            "unit": "s",
+            "config": config_name,
+            "n_subgrids": len(subgrid_configs),
+            "n_groups": n_groups,
+            "n_passes": len(subsets),
+            "clean_run": {
+                "elapsed_s": round(clean_s, 2),
+                "fault_plan_installed": False,
+            },
+            "mesh": mesh_block,
+            "resilience": resilience,
+            "plan_compiled": plan.artifact_block(
+                measured_wall_s=chaos_s
+            ),
+        }
+    finally:
+        faults.uninstall()
+        shutil.rmtree(work_dir, ignore_errors=True)
+
+
+def mesh_chaos(smoke_mode=False):
+    """`bench.py --mesh --chaos [--smoke]`: the elastic mesh recovery
+    drill — kill one of N virtual shards mid-stream, re-plan the layout
+    on the survivors, migrate the checkpoint across layouts, resume,
+    and validate the stamped ``mesh.recovery`` + ``resilience`` blocks.
+
+    ``--smoke`` runs the 1k drill (tier-1 wiring via
+    tests/test_bench_smoke.py); the full drill defaults to the 4k
+    config (slow-marked in the tests). ``SWIFTLY_FAULT_PLAN`` replaces
+    the built-in schedule; ``BENCH_MESH_CHAOS_CONFIG`` the config;
+    ``BENCH_MESH_DEVICES`` the shard count.
+    """
+    from swiftly_tpu.utils import enable_compilation_cache
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    n_req = int(os.environ.get("BENCH_MESH_DEVICES", "8"))
+    n_av = _ensure_mesh_devices(n_req)  # before any other jax use
+    key = "mesh_chaos_smoke" if smoke_mode else "mesh_chaos"
+    if n_av < 3:
+        print(
+            json.dumps(
+                {
+                    key: "failed",
+                    "problems": [
+                        f"mesh chaos drill needs >= 3 devices, found "
+                        f"{n_av}; on CPU set XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=8"
+                    ],
+                }
+            ),
+            flush=True,
+        )
+        return 1
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_mesh_artifact,
+        validate_plan_artifact,
+        validate_resilience_artifact,
+    )
+    from swiftly_tpu.resilience import plan_from_env
+
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    out_path = os.environ.get(
+        "BENCH_MESH_CHAOS_OUT", "BENCH_mesh_chaos.json"
+    )
+    metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get(
+        "BENCH_MESH_CHAOS_CONFIG",
+        "1k[1]-n512-256" if smoke_mode else "4k[1]-n2k-512",
+    )
+    from swiftly_tpu import SWIFT_CONFIGS
+
+    record = run_mesh_chaos_drill(
+        name,
+        fault_plan=plan_from_env(),
+        col_group=int(
+            os.environ.get(
+                "BENCH_CHAOS_COL_GROUP", "1" if smoke_mode else "2"
+            )
+        ),
+        fold_group=int(os.environ.get("BENCH_CHAOS_FOLD_GROUP", "2")),
+        max_cols=int(
+            os.environ.get(
+                "BENCH_MESH_CHAOS_COLS", "3" if smoke_mode else "0"
+            )
+        ),
+    )
+    record["manifest"] = run_manifest(
+        baseline_source=None, params=dict(SWIFT_CONFIGS[name])
+    )
+    record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+        from swiftly_tpu.obs import trace as otrace
+
+        record["trace"] = summarize_trace(otrace.export())
+        otrace.save(trace_path)
+        otrace.disable()
+    problems = validate_mesh_artifact(record)
+    problems.extend(validate_resilience_artifact(record))
+    problems.extend(validate_plan_artifact(record))
+    rec = record["mesh"]["recovery"]
+    # the drill's own invariants, beyond the schema: every rung of the
+    # elastic ladder must actually have been walked
+    if rec["watchdog"]["stalls_detected"] < 1:
+        problems.append(
+            "the stalled collective was never detected by the "
+            f"watchdog: {rec['watchdog']}"
+        )
+    if rec["checkpoint_fallbacks"] < 1:
+        problems.append(
+            "the corrupted checkpoint generation was never fallen "
+            "back from during migration (fallback must compose with "
+            f"layout migration): {rec}"
+        )
+    if rec["migrations"] < 1:
+        problems.append(
+            f"no checkpoint crossed a layout boundary: {rec}"
+        )
+    res = record["resilience"]
+    if res["retries"] < 1 or res["retries_recovered"] < 1:
+        problems.append(
+            f"no transient fault was retried+recovered: {res}"
+        )
+    import json as _json
+
+    with open(out_path, "w") as fh:
+        _json.dump(record, fh, indent=2)
+    metrics.disable()
+    print(
+        json.dumps(
+            {
+                key: "ok" if not problems else "failed",
+                "config": name,
+                "artifact": out_path,
+                "bit_identical": rec["bit_identical"],
+                "shards": (
+                    f"{rec['shards_before']}->{rec['shards_after']}"
+                ),
+                "recovery_overhead": rec["recovery_overhead"],
+                "stalls_detected": rec["watchdog"]["stalls_detected"],
+                "problems": problems,
+            }
+        ),
+        flush=True,
+    )
+    return 0 if not problems else 1
+
+
 def main():
     import signal
 
@@ -3395,6 +3838,8 @@ def main():
         sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--fleet" in sys.argv:
         sys.exit(fleet_bench(smoke_mode="--smoke" in sys.argv))
+    if "--mesh" in sys.argv and "--chaos" in sys.argv:
+        sys.exit(mesh_chaos(smoke_mode="--smoke" in sys.argv))
     if "--chaos" in sys.argv:
         sys.exit(chaos(smoke_mode="--smoke" in sys.argv))
     if "--mesh" in sys.argv:

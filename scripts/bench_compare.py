@@ -37,6 +37,13 @@ artifact) and exits non-zero when a leg regressed:
   below the best same-platform reference — an incremental engine that
   quietly degrades toward full-recompute cost is a regression even
   when the full-record wall holds.
+* **recovery overhead** — for mesh chaos legs (``--mesh --chaos``
+  artifacts): the ``mesh.recovery.recovery_overhead`` metric (disturbed
+  wall over undisturbed wall — how much losing a shard mid-stream
+  costs, lower is better) more than the threshold above the best
+  (lowest) same-platform reference — an elastic-recovery path that
+  quietly slows down (slower re-plan, heavier migration) is a
+  time-to-recover regression even when the clean-path wall holds.
 * **precision RMS** — for accuracy legs (``--precision`` artifacts):
   the ``rms_vs_dft_oracle`` metric (lower is better) more than the
   threshold above the best (lowest) same-platform reference — a
@@ -150,7 +157,7 @@ def compare(latest_records, reference_records, threshold=0.2):
         bucket = refs.setdefault(
             (key, leg_platform(rec)),
             {"wall": None, "mfu": None, "p99": None, "rps": None,
-             "se": None, "dse": None, "rms": None, "n": 0},
+             "se": None, "dse": None, "rms": None, "ro": None, "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -181,6 +188,13 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(rms, (int, float)) and rms > 0:
             if bucket["rms"] is None or rms < bucket["rms"]:
                 bucket["rms"] = rms
+        ro = (
+            ((rec.get("mesh") or {}).get("recovery") or {})
+            .get("recovery_overhead")
+        )
+        if isinstance(ro, (int, float)) and ro > 0:
+            if bucket["ro"] is None or ro < bucket["ro"]:
+                bucket["ro"] = ro
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -290,6 +304,24 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"delta speedup {dse:.4g}x is "
                     f"{100 * (1 - dse / ref['dse']):.1f}% below best "
                     f"reference {ref['dse']:.4g}x"
+                )
+        # mesh chaos legs: time-to-recover sentinel (disturbed wall /
+        # undisturbed wall — lower is better)
+        ro = (
+            ((rec.get("mesh") or {}).get("recovery") or {})
+            .get("recovery_overhead")
+        )
+        if isinstance(ro, (int, float)) and ro > 0:
+            verdict["recovery_overhead"] = ro
+            verdict["ref_recovery_overhead"] = ref["ro"]
+            if (
+                ref["ro"] is not None
+                and ro > ref["ro"] * (1.0 + threshold)
+            ):
+                verdict["problems"].append(
+                    f"recovery overhead {ro:.4g}x is "
+                    f"{100 * (ro / ref['ro'] - 1):.1f}% above best "
+                    f"reference {ref['ro']:.4g}x"
                 )
         # precision legs: accuracy sentinel (lower is better)
         rms = rec.get("rms_vs_dft_oracle")

@@ -1,6 +1,6 @@
 """Operator entry for the mesh-streamed engine: dryrun + bench legs.
 
-Two drills, both runnable on a laptop (virtual CPU mesh — no TPU
+Three drills, all runnable on a laptop (virtual CPU mesh — no TPU
 needed) and on real multi-chip hardware:
 
 * ``--dryrun`` (default): the extended multichip dryrun
@@ -12,6 +12,11 @@ needed) and on real multi-chip hardware:
 * ``--bench``: the `bench.py --mesh [--smoke]` leg — single-chip vs
   mesh-streamed walls, scaling efficiency, reduction-order match audit,
   schema-validated ``mesh`` artifact block.
+* ``--chaos``: the elastic recovery drill (`bench.py --mesh --chaos`)
+  — one of N virtual shards killed mid-stream, layout re-planned on
+  the survivors, checkpoint migrated across layouts, stream resumed
+  bit-identically — then prints the recovery report (shards, re-plan,
+  migration, watchdog, recovery overhead) from the stamped artifact.
 
 Host-device-count override: ``--devices N`` (default 8) re-runs the
 drill in a CHILD process with ``JAX_PLATFORMS=cpu`` and
@@ -24,6 +29,7 @@ Usage:
     python scripts/mesh_drill.py --devices 4          # 4-way dryrun
     python scripts/mesh_drill.py --bench --smoke      # mesh bench leg
     python scripts/mesh_drill.py --bench --config 4k[1]-n2k-512
+    python scripts/mesh_drill.py --chaos --smoke      # elastic drill
 
 Exit: 0 on a green drill, the child's non-zero status otherwise.
 """
@@ -52,6 +58,54 @@ def child_env(n_devices):
     return env
 
 
+def run_chaos(args, env):
+    """Drive `bench.py --mesh --chaos` in a child and print the
+    recovery report from the stamped artifact."""
+    import json
+    import tempfile
+
+    out = os.environ.get("BENCH_MESH_CHAOS_OUT") or os.path.join(
+        tempfile.gettempdir(), "BENCH_mesh_chaos.json"
+    )
+    env["BENCH_MESH_CHAOS_OUT"] = out
+    env["BENCH_MESH_DEVICES"] = str(args.devices)
+    if args.config:
+        env["BENCH_MESH_CHAOS_CONFIG"] = args.config
+    cmd = [sys.executable, str(REPO / "bench.py"), "--mesh", "--chaos"]
+    if args.smoke:
+        cmd.append("--smoke")
+    status = subprocess.run(cmd, env=env).returncode
+    try:
+        rec = json.loads(Path(out).read_text())
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"mesh_drill: no chaos artifact at {out}: {exc}",
+              file=sys.stderr)
+        return status or 1
+    r = (rec.get("mesh") or {}).get("recovery") or {}
+    wd = r.get("watchdog") or {}
+    print()
+    print("elastic mesh recovery report")
+    print(f"  artifact            {out}")
+    print(f"  config              {rec.get('config')}")
+    print(f"  shards              {r.get('shards_before')} -> "
+          f"{r.get('shards_after')} "
+          f"(lost via {r.get('kill_site')} "
+          f"call {r.get('kill_at_call')})")
+    rp = r.get("replanned") or {}
+    print(f"  re-planned layout   facet_shards={rp.get('facet_shards')} "
+          f"padded_facets={rp.get('padded_facets')} "
+          f"collective_bytes={rp.get('collective_bytes_total')}")
+    print(f"  migration           {r.get('subgrids_migrated')} "
+          f"subgrid(s) across layouts, "
+          f"{r.get('checkpoint_fallbacks')} generation fallback(s)")
+    print(f"  watchdog            timeout={wd.get('timeout_s')}s, "
+          f"stalls detected={wd.get('stalls_detected')}")
+    print(f"  recovery wall       {r.get('recovery_wall_s')}s "
+          f"(overhead x{r.get('recovery_overhead')} vs undisturbed)")
+    print(f"  bit identical       {r.get('bit_identical')}")
+    return status
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="mesh-streamed engine drill: dryrun HLO/numerics "
@@ -71,12 +125,18 @@ def main(argv=None):
         help="run the bench.py --mesh leg instead of the dryrun",
     )
     ap.add_argument(
+        "--chaos", action="store_true",
+        help="run the elastic recovery drill (bench.py --mesh --chaos) "
+             "and print the recovery report",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
-        help="with --bench: the smoke-scale config",
+        help="with --bench/--chaos: the smoke-scale config",
     )
     ap.add_argument(
         "--config", default=None,
-        help="with --bench: config name (BENCH_MESH_CONFIG)",
+        help="with --bench/--chaos: config name (BENCH_MESH_CONFIG / "
+             "BENCH_MESH_CHAOS_CONFIG)",
     )
     args = ap.parse_args(argv)
 
@@ -90,6 +150,8 @@ def main(argv=None):
         return 0
 
     env = child_env(args.devices)
+    if args.chaos:
+        return run_chaos(args, env)
     if args.bench:
         env["BENCH_MESH_DEVICES"] = str(args.devices)
         if args.config:

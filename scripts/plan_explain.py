@@ -28,6 +28,13 @@ predicted to hide the feed entirely (the h2d/compute overlap).
 ``--feed-group`` forces passes-per-feed, mirroring bench's
 ``BENCH_BWD_FEED_GROUP``.
 
+With ``--devices N`` (N > 1) the report ends with the DEGRADED-LAYOUT
+table: the mesh layout the compiler would re-plan onto after losing a
+shard (N-1 devices) and after losing half the mesh (N/2) — the same
+`plan.plan_mesh_layout` call the elastic recovery ladder makes
+mid-stream (`mesh.recovery`), so an operator can read the post-failure
+shape and per-shard footprint BEFORE a failure forces it.
+
 Exit: 0 on a printed plan, 2 on a bad config/inputs.
 """
 
@@ -78,7 +85,9 @@ def main(argv=None):
     )
     ap.add_argument(
         "--devices", type=int, default=1,
-        help="device count for the mesh-layout stub (default 1)",
+        help="device count for the mesh-layout stub (default 1); with "
+             "N > 1 the report adds the degraded-layout table (the "
+             "re-planned layouts at N-1 and N/2 survivors)",
     )
     ap.add_argument(
         "--fold-group", type=int, default=2,
@@ -160,6 +169,37 @@ def main(argv=None):
         print(json.dumps(plan.artifact_block(), indent=2))
         return 0
     print(plan.explain())
+    if args.devices > 1:
+        from swiftly_tpu.plan import plan_mesh_layout
+
+        print()
+        print(
+            "  degraded layouts (what the elastic recovery ladder "
+            "re-plans onto after shard loss):"
+        )
+        print(
+            "    devices  shards  padded  per-shard stack  "
+            "collective/col  fits HBM"
+        )
+        for k in dict.fromkeys(
+            [args.devices, args.devices - 1, args.devices // 2]
+        ):
+            if k < 1:
+                continue
+            lay = plan_mesh_layout(
+                inputs.replace(n_devices=k), args.mode
+            )
+            tag = "" if k == args.devices else (
+                "  (one shard lost)" if k == args.devices - 1
+                else "  (half the mesh lost)"
+            )
+            print(
+                f"    {k:7d}  {lay.facet_shards:6d}  "
+                f"{lay.padded_facets:6d}  "
+                f"{lay.per_shard_stack_bytes / 2 ** 20:12.1f} MiB  "
+                f"{lay.collective_bytes_per_column / 2 ** 20:11.1f} MiB"
+                f"  {str(lay.fits_hbm):>8s}{tag}"
+            )
     if coeffs is not None:
         print(
             f"  coefficients: {coeffs.source} "

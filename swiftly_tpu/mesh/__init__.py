@@ -21,9 +21,16 @@ Quick start (CPU simulation: 8 virtual devices)::
     facets = bwd.finish()
     EOF
 
+`mesh.recovery` adds the elastic rung: a shard lost mid-stream
+(`ShardLostError` — injected, or a watchdog-caught stalled collective)
+re-plans the layout on the survivors, migrates the last autosave across
+layouts and resumes the stream bit-identically (``bench.py --mesh
+--chaos`` is the drill).
+
 See docs/multichip.md for the layout/env knobs, the CPU host-device
-simulation recipe, and the reduction-order tolerance contract; the
-`bench.py --mesh` leg measures scaling vs the single-chip engine.
+simulation recipe, the reduction-order tolerance contract and the
+failure semantics; the `bench.py --mesh` leg measures scaling vs the
+single-chip engine.
 """
 
 from ..parallel.mesh import (
@@ -42,6 +49,7 @@ from .engine import (
     host_replica,
     resolve_facet_shards,
 )
+from .recovery import recover_engines, run_elastic_pass, survivor_mesh
 
 __all__ = [
     "FACET_AXIS",
@@ -55,5 +63,8 @@ __all__ = [
     "make_facet_mesh",
     "mesh_size",
     "pad_to_shards",
+    "recover_engines",
     "resolve_facet_shards",
+    "run_elastic_pass",
+    "survivor_mesh",
 ]

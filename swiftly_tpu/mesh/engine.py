@@ -34,6 +34,15 @@ turns those pieces into a mesh-streamed ENGINE:
   over `MeshStreamedForward`/`MeshStreamedBackward` unchanged (the
   plan's ``backward.feed_group`` sizes the chunk; ``bench.py --mesh``
   routes both its single-chip reference and the mesh run through it).
+* Elastic recovery surface: the engines carry the mesh-path fault
+  sites (``mesh.psum`` on the host sync downstream of the column
+  psum — watchdog-wrapped when ``SWIFTLY_COLLECTIVE_TIMEOUT_S`` is
+  set, so a stalled collective raises instead of hanging;
+  ``mesh.shard_loss`` once per yielded forward group; ``mesh.feed``
+  per backward group feed) and a ``rebuild_on(mesh, layout)`` hook
+  that re-constructs the same engine on a SURVIVOR mesh —
+  `mesh.recovery` drives detect → re-plan → migrate → resume over
+  these (docs/resilience.md).
 
 Exactness contract: per-facet math is byte-identical to the single-chip
 engine (the shard_map bodies are built from the same ``*_fn`` builders);
@@ -66,6 +75,7 @@ from ..parallel.mesh import (
 from ..parallel.streamed import StreamedBackward, StreamedForward
 from ..resilience.faults import fault_point as _fault_point
 from ..resilience.retry import retry_transient as _retry
+from ..resilience.watchdog import watch_collective as _watch
 
 __all__ = [
     "MeshStreamedBackward",
@@ -210,10 +220,35 @@ class MeshStreamedForward(StreamedForward):
         )
         self.mesh = mesh
         self.layout = _bind_layout(layout, self)
+        self._rebuild_kw = dict(
+            swiftly_config=swiftly_config, facet_tasks=facet_tasks,
+            col_block=col_block, col_group=col_group,
+        )
 
     @property
     def facet_shards(self):
         return mesh_size(self.mesh)
+
+    def rebuild_on(self, mesh, layout=None):
+        """A fresh engine of the SAME construction on a different mesh.
+
+        The elastic recovery hook: after a shard loss, `mesh.recovery`
+        re-plans the layout on the survivors and rebuilds the engines
+        here — same config/facets/blocking, new fabric. The original
+        engine is left untouched (its devices may be gone; nothing is
+        torn down through them)."""
+        return type(self)(mesh=mesh, layout=layout, **self._rebuild_kw)
+
+    def stream_column_groups(self, subgrid_configs, spill=None):
+        """`StreamedForward.stream_column_groups` with the
+        ``mesh.shard_loss`` fault site fired once per yielded group —
+        the canonical place a drill kills one of N virtual shards
+        mid-stream (between group boundaries, where an autosave-aligned
+        resume is possible)."""
+        for item in super().stream_column_groups(subgrid_configs,
+                                                 spill=spill):
+            _fault_point("mesh.shard_loss")
+            yield item
 
     def layout_summary(self):
         """The executed mesh layout as a dict (artifact-ready)."""
@@ -228,16 +263,29 @@ class MeshStreamedForward(StreamedForward):
     def _spill_store(self, spill, per_col, out_g):
         """Copy one yielded group's stack to the cache — reading only
         an addressable replica of the (replicated) group output, so the
-        spill fill never addresses another host's devices."""
+        spill fill never addresses another host's devices.
+
+        This host pull is the first point the stream BLOCKS on the
+        column group's psum completing, which makes it the engine's
+        stall-detection site: the sync runs through the ``mesh.psum``
+        fault point under the collective watchdog
+        (``SWIFTLY_COLLECTIVE_TIMEOUT_S``), so a collective hung on a
+        dead peer raises `CollectiveStalledError` — a catchable shard
+        loss — instead of blocking the host forever."""
         if spill.gave_up:
             return
 
         def pull():
             _fault_point("transfer.d2h")
-            with _metrics.stage("spill.write") as st:
-                arr = host_replica(out_g)
-                st.bytes_moved = int(arr.nbytes)
-            return arr
+
+            def sync():
+                _fault_point("mesh.psum")
+                with _metrics.stage("spill.write") as st:
+                    arr = host_replica(out_g)
+                    st.bytes_moved = int(arr.nbytes)
+                return arr
+
+            return _watch(sync, "mesh.psum")
 
         host = _retry(pull, site="transfer.d2h")
         if spill.put(per_col, host) and _metrics.enabled():
@@ -273,10 +321,32 @@ class MeshStreamedBackward(StreamedBackward):
         )
         self.mesh = mesh
         self.layout = _bind_layout(layout, self)
+        self._rebuild_kw = dict(
+            swiftly_config=swiftly_config, facet_configs=facet_configs,
+            col_block=col_block, residency=residency,
+            fold_group=fold_group, row_slab=row_slab,
+        )
 
     @property
     def facet_shards(self):
         return mesh_size(self.mesh)
+
+    def rebuild_on(self, mesh, layout=None):
+        """A fresh engine of the SAME construction on a different mesh
+        (see `MeshStreamedForward.rebuild_on`). The rebuilt backward
+        starts empty — `mesh.recovery` migrates the last autosave into
+        it via `utils.checkpoint.restore_streamed_backward_state`,
+        which re-pads the facet stacks for the new layout."""
+        return type(self)(mesh=mesh, layout=layout, **self._rebuild_kw)
+
+    def add_subgrid_group(self, col_sg_lists, subgrids_group):
+        """`StreamedBackward.add_subgrid_group` behind the ``mesh.feed``
+        fault site (the per-group mesh feed boundary — distinct from the
+        engine-generic ``bwd.feed`` fired inside, so mesh drills can
+        target the mesh path without faulting the single-chip
+        reference run)."""
+        _fault_point("mesh.feed")
+        return super().add_subgrid_group(col_sg_lists, subgrids_group)
 
     def finish(self):
         """Finished facet stack as a host array, pulled from addressable

@@ -1,0 +1,242 @@
+"""Elastic mesh recovery: survive device loss mid-stream.
+
+A lost shard on an N-device mesh is the one failure the PR-4 resilience
+ladder could not absorb: retries cannot bring a device back, checkpoint
+generations all describe the DEAD layout, and the stream has nowhere to
+resume onto. The paper's Dask original gets this for free from its
+scheduler (DaggerFFT, arXiv 2601.12209, re-schedules a lost worker's
+tasks); a TPU-native static-layout stack has to rebuild the property —
+the wafer-scale slide-FFT work (arXiv 2401.05427) makes the argument
+that layouts must be RE-DERIVABLE after topology change, not pinned.
+
+This module is the new rung of the degradation ladder::
+
+    shard lost (ShardLostError — injected, or a watchdog-detected
+                stalled collective)
+      → re-PLAN the layout on the survivors
+        (`plan.plan_mesh_layout` on ``inputs.replace(n_devices=k)`` —
+        the shrunk layout is priced by the same cost model that chose
+        the original, not guessed)
+      → REBUILD the engines on a survivor mesh
+        (`MeshStreamedForward/Backward.rebuild_on`: same config, same
+        facets, new fabric)
+      → MIGRATE the last autosave across layouts
+        (`utils.checkpoint.restore_streamed_backward_state` gathers the
+        saved facet stacks, re-pads them for the survivor shard count
+        and re-places — `ckpt.migrations`)
+      → RESUME the column stream at the last autosave group boundary
+        (processed groups skipped, the spill cache re-feeds the rest).
+
+Bit-identity contract: the backward's folds and finishes are
+shard-local per-facet math (byte-identical on ANY layout — only the
+forward column psum's reduction order depends on the shard count), and
+the resumed feed replays CACHED subgrid bytes fixed by the original
+recording. So a loss during a cache-fed pass recovers to a result
+bit-identical to the undisturbed run — the same contract the PR-4
+kill-and-resume drill pins on one chip, now across a layout change.
+``bench.py --mesh --chaos`` asserts exactly this.
+
+Everything is observable: ``mesh.recovery.*`` counters, trace instants
+at detection/re-plan/resume, and a `report` dict shaped for the
+``mesh.recovery`` artifact block (`obs.validate_mesh_artifact`).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..parallel.mesh import make_facet_mesh, mesh_size
+from ..resilience import degrade as _degrade
+from ..resilience.faults import ShardLostError
+from ..resilience.watchdog import collective_timeout_s
+from ..utils.checkpoint import (
+    checkpoint_generations,
+    restore_streamed_backward_state,
+)
+
+__all__ = [
+    "recover_engines",
+    "run_elastic_pass",
+    "survivor_mesh",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def survivor_mesh(mesh, lost_shard=None):
+    """(mesh', lost) — a fresh 1-D facet mesh over the survivors of
+    losing one shard of `mesh`.
+
+    :param lost_shard: index of the dead shard; default the LAST shard
+        (deterministic for drills — a real detector would pass the
+        shard whose collective stalled).
+    """
+    devices = list(mesh.devices.flat)
+    lost = len(devices) - 1 if lost_shard is None else int(lost_shard)
+    if not 0 <= lost < len(devices):
+        raise ValueError(
+            f"lost_shard {lost} out of range for a "
+            f"{len(devices)}-device mesh"
+        )
+    survivors = [d for i, d in enumerate(devices) if i != lost]
+    if not survivors:
+        raise ShardLostError(
+            "no surviving devices to re-plan onto", shard=lost
+        )
+    return make_facet_mesh(devices=survivors), lost
+
+
+def recover_engines(forward, backward, plan_inputs=None,
+                    mode="roundtrip-streamed", lost_shard=None,
+                    ckpt_path=None):
+    """One recovery step: re-plan, rebuild, migrate. Returns
+    ``(forward', backward', processed, info)``.
+
+    The original engines are left untouched (their mesh may contain the
+    dead device; nothing is torn down through it). ``processed`` is the
+    migrated checkpoint's (off0, off1) ledger — the groups the resumed
+    feed skips — or ``()`` when no checkpoint generation exists (the
+    loss landed before the first autosave: recovery degrades to a full
+    re-run on the survivor layout, still exact).
+
+    :param plan_inputs: the `plan.PlanInputs` the original layout was
+        compiled from; when given, the survivor layout comes from
+        `plan.plan_mesh_layout` on ``replace(n_devices=survivors)`` —
+        priced by the cost model — and is bound by the rebuilt engines.
+    """
+    t0 = time.monotonic()
+    before = mesh_size(forward.mesh)
+    _metrics.count("mesh.recovery.events")
+    _trace.instant(
+        "mesh.recovery.detected", cat="fault",
+        shards=before, lost_shard=lost_shard,
+    )
+    mesh, lost = survivor_mesh(forward.mesh, lost_shard)
+    layout = None
+    if plan_inputs is not None:
+        from ..plan import plan_mesh_layout
+
+        layout = plan_mesh_layout(
+            plan_inputs.replace(n_devices=mesh_size(mesh)), mode
+        )
+        _metrics.count("mesh.recovery.replans")
+    _trace.instant(
+        "mesh.recovery.replanned", cat="fault",
+        shards=mesh_size(mesh),
+        facet_shards=(layout.facet_shards if layout else None),
+    )
+    new_fwd = forward.rebuild_on(mesh, layout)
+    new_bwd = backward.rebuild_on(mesh, layout)
+    processed = ()
+    migrated = False
+    if ckpt_path and checkpoint_generations(ckpt_path):
+        # cross-layout restore: checkpoint.py gathers the saved facet
+        # stacks, re-pads for the survivor shard count and re-places
+        processed = restore_streamed_backward_state(ckpt_path, new_bwd)
+        migrated = True
+    wall = time.monotonic() - t0
+    _degrade.record(
+        "mesh", "replan_survivors",
+        f"shard {lost} lost; re-planned {before} -> {mesh_size(mesh)} "
+        f"shard(s), {len(processed)} subgrid(s) migrated",
+    )
+    _trace.instant(
+        "mesh.recovery.resumed", cat="fault",
+        shards=mesh_size(mesh), skipped=len(processed),
+        recovery_wall_s=wall,
+    )
+    logger.warning(
+        "mesh recovery: shard %s lost; re-planned %d -> %d shard(s) "
+        "in %.3fs (%d subgrid(s) already folded)",
+        lost, before, mesh_size(mesh), wall, len(processed),
+    )
+    info = {
+        "shards_before": int(before),
+        "shards_after": int(mesh_size(mesh)),
+        "lost_shard": int(lost),
+        "replanned": layout.as_dict() if layout is not None else None,
+        "migrated": migrated,
+        "subgrids_migrated": len(processed),
+        "recovery_wall_s": wall,
+    }
+    return new_fwd, new_bwd, processed, info
+
+
+def run_elastic_pass(forward, backward, subgrid_configs, spill,
+                     ckpt_path, plan_inputs=None,
+                     mode="roundtrip-streamed", autosave_every=1,
+                     max_recoveries=1):
+    """Feed the column stream into `backward`, surviving shard loss.
+
+    Streams `forward.stream_column_groups(subgrid_configs, spill=...)`
+    into ``backward.add_subgrid_group`` with per-group autosave to
+    `ckpt_path`. A `ShardLostError` anywhere in the loop (an injected
+    ``mesh.shard_loss``/``mesh.feed`` fault, or the watchdog's
+    `CollectiveStalledError` from a stalled ``mesh.psum``) triggers
+    `recover_engines`; the pass resumes on the rebuilt engines at the
+    last autosave boundary, skipping fully-processed groups — the same
+    skip discipline as the PR-4 kill-and-resume drill.
+
+    Returns ``(forward', backward', report)``: the (possibly rebuilt)
+    engines — the backward with the pass fully folded in (callers
+    ``finish()`` it), the forward to drive any LATER passes on the
+    surviving fabric — and the ``mesh.recovery``-shaped report dict::
+
+        {"events": int, "recoveries": [info, ...],
+         "watchdog": {"timeout_s": float|None},
+         "shards_before": int, "shards_after": int,
+         "recovery_wall_s": float}
+
+    At most `max_recoveries` losses are absorbed; one more re-raises
+    (a mesh losing shards faster than it can re-plan is an outage, not
+    a degradation).
+    """
+    fwd, bwd = forward, backward
+    shards0 = mesh_size(fwd.mesh)
+    bwd.enable_autosave(ckpt_path, every_subgrids=autosave_every)
+    skip = set()
+    recoveries = []
+    while True:
+        try:
+            for per_col, group in fwd.stream_column_groups(
+                subgrid_configs, spill=spill
+            ):
+                keys = [
+                    (sg.off0, sg.off1) for col in per_col for _, sg in col
+                ]
+                if skip and all(k in skip for k in keys):
+                    continue
+                bwd.add_subgrid_group(
+                    [[sg for _, sg in col] for col in per_col], group
+                )
+            break
+        except ShardLostError as exc:
+            if len(recoveries) >= max_recoveries:
+                raise
+            logger.warning(
+                "mesh pass: %s; walking the recovery ladder", exc
+            )
+            fwd, bwd, processed, info = recover_engines(
+                fwd, bwd,
+                plan_inputs=plan_inputs, mode=mode,
+                lost_shard=getattr(exc, "shard", None),
+                ckpt_path=ckpt_path,
+            )
+            info["detected_via"] = type(exc).__name__
+            recoveries.append(info)
+            skip = set(map(tuple, processed))
+            bwd.enable_autosave(ckpt_path, every_subgrids=autosave_every)
+    report = {
+        "events": len(recoveries),
+        "recoveries": recoveries,
+        "watchdog": {"timeout_s": collective_timeout_s()},
+        "shards_before": int(shards0),
+        "shards_after": int(mesh_size(bwd.mesh)),
+        "recovery_wall_s": float(
+            sum(r["recovery_wall_s"] for r in recoveries)
+        ),
+    }
+    return fwd, bwd, report

@@ -544,6 +544,101 @@ MESH_ARTIFACT_FIELDS = (
     "hlo",
 )
 
+# The mesh block of a `bench.py --mesh --chaos` artifact: the chaos
+# drill races nothing (no single-chip leg, no HLO audit — those are the
+# scaling leg's contract); its match audit is the BIT-identity of the
+# recovered run vs the undisturbed mesh run, and it must carry the
+# recovery block below.
+MESH_CHAOS_ARTIFACT_FIELDS = (
+    "n_devices",
+    "facet_shards",
+    "padded_facets",
+    "collective_bytes",
+    "match",
+    "recovery",
+)
+
+# The `mesh.recovery` block schema — the elastic-recovery drill's
+# contract: what was lost, what the survivors re-planned to (priced by
+# the plan compiler, not guessed), whether the checkpoint migrated
+# across layouts, how long the ladder took (`recovery_wall_s`, and
+# `recovery_overhead` = disturbed/undisturbed wall ratio — the
+# bench_compare sentinel), and whether the resumed result stayed
+# bit-identical.
+MESH_RECOVERY_FIELDS = (
+    "events",
+    "shards_before",
+    "shards_after",
+    "replanned",
+    "migrated",
+    "subgrids_migrated",
+    "watchdog",
+    "recovery_wall_s",
+    "recovery_overhead",
+    "bit_identical",
+)
+
+
+def _mesh_recovery_problems(recovery):
+    """Schema problems with one `mesh.recovery` block."""
+    if not isinstance(recovery, dict):
+        return ["mesh recovery block is not a dict"]
+    problems = []
+    for field in MESH_RECOVERY_FIELDS:
+        if field not in recovery:
+            problems.append(f"mesh recovery block missing {field!r}")
+    events = recovery.get("events")
+    if isinstance(events, int) and events < 1:
+        problems.append(
+            "mesh recovery drill recovered from no shard loss"
+        )
+    before = recovery.get("shards_before")
+    after = recovery.get("shards_after")
+    if (
+        isinstance(before, int) and isinstance(after, int)
+        and not (1 <= after < before)
+    ):
+        problems.append(
+            f"recovery shards {before} -> {after} did not shrink to a "
+            "surviving layout"
+        )
+    replanned = recovery.get("replanned")
+    if isinstance(replanned, dict):
+        if (
+            isinstance(after, int)
+            and replanned.get("facet_shards") not in (None, after)
+        ):
+            problems.append(
+                f"re-planned layout shards "
+                f"{replanned.get('facet_shards')} != surviving "
+                f"shard count {after}"
+            )
+    elif "replanned" in recovery:
+        problems.append(
+            "recovery replanned block is not a layout dict — the "
+            "survivor layout must come from the plan compiler, not "
+            "be guessed"
+        )
+    if recovery.get("migrated") is not True:
+        problems.append(
+            "recovery did not migrate a checkpoint across layouts"
+        )
+    if not isinstance(recovery.get("watchdog"), dict):
+        problems.append("recovery watchdog block is not a dict")
+    for field in ("recovery_wall_s", "recovery_overhead"):
+        v = recovery.get(field)
+        if v is not None and (
+            not isinstance(v, (int, float)) or v <= 0
+        ):
+            problems.append(f"recovery {field} {v!r} is not positive")
+    if recovery.get("bit_identical") is not True:
+        problems.append(
+            f"recovery bit_identical is "
+            f"{recovery.get('bit_identical')!r}; the recovered stream "
+            "must equal the undisturbed run exactly"
+        )
+    return problems
+
 
 def validate_mesh_artifact(record):
     """Problems with a mesh-mode BENCH artifact, as a list of strings.
@@ -559,15 +654,32 @@ def validate_mesh_artifact(record):
     streamed stage, and ``plan_compiled.mesh.status == "bound"`` — a
     mesh drill whose plan nothing consumed, or whose results drifted
     past tolerance, is a correctness bug, not a scaling result.
+
+    A ``mesh.recovery`` block switches the schema to the elastic
+    recovery drill's (``bench.py --mesh --chaos``): the scaling-leg
+    fields (single-chip wall, scaling_efficiency, hlo) are not
+    required, but the recovery block must be coherent — >= 1 recovery
+    event, shards genuinely shrunk, a re-planned survivor layout whose
+    shard count matches, a checkpoint migration, positive recovery
+    wall/overhead, and ``bit_identical`` True (the recovered stream
+    must equal the undisturbed run EXACTLY; a drifted recovery is a
+    correctness bug, not a resilience result).
     """
     problems = validate_artifact(record, require_baseline=False)
     mesh = record.get("mesh")
     if not isinstance(mesh, dict):
         problems.append("missing mesh block")
         return problems
-    for field in MESH_ARTIFACT_FIELDS:
+    recovery = mesh.get("recovery")
+    required = (
+        MESH_ARTIFACT_FIELDS if recovery is None
+        else MESH_CHAOS_ARTIFACT_FIELDS
+    )
+    for field in required:
         if field not in mesh:
             problems.append(f"mesh block missing {field!r}")
+    if recovery is not None:
+        problems.extend(_mesh_recovery_problems(recovery))
     shards = mesh.get("facet_shards")
     if isinstance(shards, int) and shards < 2:
         problems.append(

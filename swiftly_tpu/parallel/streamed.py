@@ -2205,16 +2205,17 @@ class CachedColumnFeed:
 
     def lookup(self, config):
         """The recorded host row for ``config``, or None on a miss;
-        raises LookupError when the index hit an evicted entry, the
-        cache's stream version moved since this feed was built (a
-        facet update patched the rows — this feed is stale), or the
+        raises LookupError when the index hit an evicted entry or the
+        whole recorded stream was dropped (a ``reset`` cleared
+        ``complete`` — counted as an eviction), when the cache's
+        stream version moved since this feed was built (a facet
+        update patched the rows — this feed is stale), or when the
         cache is mid-rewrite (``patching`` set by
-        `utils.spill.SpillCache.begin_patch`, or ``complete`` dropped
-        by a replay's refill) — a partially-patched stream must never
-        serve, even to a concurrent reader that races the patcher."""
-        if getattr(self._spill, "patching", False) or not getattr(
-            self._spill, "complete", False
-        ):
+        `utils.spill.SpillCache.begin_patch`, which also brackets a
+        replay's reset-to-refill window) — a partially-patched stream
+        must never serve, even to a concurrent reader that races the
+        patcher."""
+        if getattr(self._spill, "patching", False):
             self.stale += 1
             if _metrics.enabled():
                 _metrics.count("spill.feed_stale")
@@ -2222,6 +2223,15 @@ class CachedColumnFeed:
                 "cached stream is mid-update (a facet patch or replay "
                 "is rewriting its entries); fall back to compute and "
                 "rebuild the feed once the update lands"
+            )
+        if not getattr(self._spill, "complete", False):
+            self.evicted += 1
+            if _metrics.enabled():
+                _metrics.count("spill.feed_evictions")
+            raise LookupError(
+                "recorded stream is no longer complete (a reset or "
+                "eviction dropped its entries since this feed was "
+                "indexed); fall back to compute"
             )
         current = int(getattr(self._spill, "stream_version", 0))
         if current != self.stream_version:

@@ -18,8 +18,13 @@ package is the discipline layer:
   escalating reopen) the serve fleet gates each replica with.
 * ``resilience.degrade`` — the graceful-degradation ledger every
   ladder step (spill disk -> RAM -> replay; corrupt checkpoint ->
-  previous generation; fused batch -> split -> per-request) records
-  into, stamped into chaos artifacts.
+  previous generation; fused batch -> split -> per-request; lost
+  mesh shard -> re-planned survivor layout) records into, stamped
+  into chaos artifacts.
+* ``resilience.watchdog`` — the stalled-collective watchdog
+  (``SWIFTLY_COLLECTIVE_TIMEOUT_S``): turns a hung mesh psum into a
+  caught :class:`CollectiveStalledError` so the elastic recovery
+  ladder (`mesh.recovery`) can re-plan instead of hanging forever.
 
 Hardened checkpointing (atomic tmp+fsync+rename writes, per-array
 CRC32, keep-N generation rotation with automatic fallback) lives in
@@ -33,6 +38,7 @@ from .faults import (
     FaultError,
     FaultPlan,
     InjectedResourceExhausted,
+    ShardLostError,
     WorkerKilled,
     active,
     fault_point,
@@ -41,18 +47,26 @@ from .faults import (
     uninstall,
 )
 from .retry import backoff_delay, is_oom, is_transient, retry_transient
+from .watchdog import (
+    CollectiveStalledError,
+    collective_timeout_s,
+    watch_collective,
+)
 
 __all__ = [
     "CLOSED",
     "CircuitBreaker",
+    "CollectiveStalledError",
     "FaultError",
     "FaultPlan",
     "HALF_OPEN",
     "InjectedResourceExhausted",
     "OPEN",
+    "ShardLostError",
     "WorkerKilled",
     "active",
     "backoff_delay",
+    "collective_timeout_s",
     "degrade",
     "fault_point",
     "install",
@@ -61,4 +75,5 @@ __all__ = [
     "plan_from_env",
     "retry_transient",
     "uninstall",
+    "watch_collective",
 ]

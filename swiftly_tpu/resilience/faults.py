@@ -30,6 +30,12 @@ Fault kinds:
 * ``kill``      — raise :class:`WorkerKilled` (a ``BaseException``:
   it tears through every ``except Exception`` isolation layer, the
   way a real SIGKILL would — only an explicit drill harness catches it)
+* ``shard_loss`` — raise :class:`ShardLostError` (an ``Exception``,
+  unlike ``kill``: losing ONE shard of a mesh is a survivable,
+  *recoverable* event — the elastic recovery ladder catches it,
+  re-plans the layout on the survivors and resumes; it is deliberately
+  NOT transient-classified, because retrying the same collective on
+  the same dead mesh cannot succeed)
 
 Schedules are per-site call-indexed and deterministic: ``at`` fires on
 the Nth call to the site (0-based), ``every`` fires periodically, ``p``
@@ -44,7 +50,13 @@ Known sites (see docs/resilience.md for the full table):
 ``checkpoint.restore``, ``serve.dispatch``, ``bwd.feed``,
 ``fleet.replica.kill`` (every replica pump iteration — ``kill`` here
 is simulated chip death), ``fleet.health.probe`` (each active health
-probe), ``fleet.route`` (every fleet routing decision).
+probe), ``fleet.route`` (every fleet routing decision),
+``mesh.psum`` (the mesh engine's one collective per column group —
+``latency`` here simulates a stalled all-reduce for the watchdog,
+``shard_loss`` a device dropping out of it), ``mesh.feed`` (each
+mesh backward group feed), ``mesh.shard_loss`` (each mesh forward
+column-group yield — the canonical site for killing one of N virtual
+shards mid-stream).
 """
 
 from __future__ import annotations
@@ -64,6 +76,7 @@ __all__ = [
     "FaultError",
     "FaultPlan",
     "InjectedResourceExhausted",
+    "ShardLostError",
     "WorkerKilled",
     "active",
     "corrupt_array",
@@ -75,7 +88,7 @@ __all__ = [
     "uninstall",
 ]
 
-KINDS = ("ioerror", "oom", "corrupt", "latency", "kill")
+KINDS = ("ioerror", "oom", "corrupt", "latency", "kill", "shard_loss")
 
 
 class FaultError(IOError):
@@ -91,6 +104,26 @@ class WorkerKilled(BaseException):
     """Simulated worker death. Deliberately NOT an ``Exception``: retry
     wrappers and isolation layers must not absorb it — only a drill
     harness that then exercises the resume path catches it."""
+
+
+class ShardLostError(RuntimeError):
+    """One shard of a mesh dropped out mid-stream.
+
+    Unlike :class:`WorkerKilled` this IS an ``Exception`` — a single
+    shard loss on an N-device mesh is survivable, and the elastic
+    recovery ladder (``mesh.recovery``) is built to catch it, re-plan
+    the layout on the surviving devices and resume from the last
+    autosave. It carries no transient marker and is not an
+    ``OSError``, so `resilience.retry.is_transient` correctly refuses
+    to retry it in place: the same collective on the same broken mesh
+    can never succeed, only a re-planned one can.
+
+    :param shard: the lost shard's index when known, else None.
+    """
+
+    def __init__(self, message, shard=None):
+        super().__init__(message)
+        self.shard = shard
 
 
 def corrupt_array(arr, rng=None):
@@ -236,6 +269,10 @@ class FaultPlan:
             )
         if hit.kind == "kill":
             raise WorkerKilled(f"injected worker death at {site} (call {n})")
+        if hit.kind == "shard_loss":
+            raise ShardLostError(
+                f"injected shard loss at {site} (call {n})"
+            )
         if hit.kind == "latency":
             time.sleep(hit.delay_s)
             return payload

@@ -29,6 +29,12 @@ docs/resilience.md):
 * **Fault sites.** ``checkpoint.save`` / ``checkpoint.save.done`` /
   ``checkpoint.restore`` are `resilience.faults` hook points — the
   chaos drill corrupts and kills here on a schedule.
+* **Cross-layout migration.** Streamed snapshots record the mesh
+  layout they were sharded with; restoring onto a DIFFERENT device
+  count (the elastic-recovery case: a shard died and the survivors
+  re-planned) migrates the saved facet stacks — real facets kept,
+  layout padding regrown, arrays re-placed onto the new mesh — exactly,
+  so a migrated resume stays bit-identical to an undisturbed run.
 * **Observability.** The ``ckpt.save`` / ``ckpt.restore`` stage timers
   double as trace spans when `obs.trace` is on (the metrics→trace
   bridge), so a recorded timeline shows save/restore windows — with
@@ -330,7 +336,7 @@ def _restore_backward_one(path, backward):
         return [tuple(p) for p in meta["processed"]]
 
 
-def _check_meta(meta, core, n_total, kind):
+def _check_meta(meta, core, n_total, kind, n_real=None):
     if meta["version"] not in _SUPPORTED_VERSIONS:
         raise ValueError(f"Unsupported checkpoint version {meta['version']}")
     # legacy files (written by save_backward_state before "kind" existed)
@@ -346,8 +352,40 @@ def _check_meta(meta, core, n_total, kind):
             f"backend {meta['backend']!r}; this session has {expect} "
             f"backend {core.backend!r}"
         )
-    if meta["n_total"] != n_total:
+    if n_real is not None:
+        # cross-layout migration: the padded stack size is a property of
+        # the LAYOUT (facets round up to a shard multiple), so only the
+        # REAL facet count must match — padding facets are exactly zero
+        # and are dropped/regrown by `_migrate_stack`
+        if meta.get("n_real") != n_real:
+            raise ValueError("Facet stack size mismatch")
+    elif meta["n_total"] != n_total:
         raise ValueError("Facet stack size mismatch")
+
+
+def _migrate_stack(arr, n_real, n_total):
+    """Re-shape a saved facet-stacked array (axis 0 = facets, padded to
+    the SAVING layout's shard multiple) for a different layout: keep the
+    `n_real` real facets, re-pad with zeros to the new `n_total`.
+
+    Exact by construction: padding facets are zero-masked in the forward
+    and fold to zero in the backward whatever layout assumes them, so
+    dropping one layout's padding and growing another's changes no real
+    accumulator byte — the migrated restore stays bit-identical.
+    """
+    arr = np.asarray(arr)
+    if arr.shape[0] < n_real:
+        raise CorruptCheckpointError(
+            f"facet-stacked array holds {arr.shape[0]} facets; "
+            f"{n_real} real facets expected"
+        )
+    arr = arr[:n_real]
+    if arr.shape[0] < n_total:
+        pad = np.zeros(
+            (n_total - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype
+        )
+        arr = np.concatenate([arr, pad], axis=0)
+    return arr
 
 
 def save_streamed_backward_state(path, backward, processed_subgrids=None):
@@ -418,9 +456,15 @@ def restore_streamed_backward_state(path, backward):
     """Restore a snapshot into a freshly constructed StreamedBackward.
 
     The instance must be built with the same config/facet list (and may
-    use either residency — accumulators are re-placed to match). Corrupt
-    generations fall back to the previous good one. Returns the list of
-    (off0, off1) subgrids already processed (also assigned to
+    use either residency — accumulators are re-placed to match). The
+    MESH LAYOUT may differ from the saving session's: snapshots written
+    on an N-device mesh migrate onto any other device count (including
+    single-chip, and vice versa) via gather→re-shard — the real facets
+    are kept, layout padding is regrown, and the arrays are re-placed
+    onto the new mesh (counted as ``ckpt.migrations`` and recorded in
+    the degradation ledger). Corrupt generations fall back to the
+    previous good one; fallback and migration compose. Returns the list
+    of (off0, off1) subgrids already processed (also assigned to
     ``backward.processed``).
     """
     return _restore_with_fallback(
@@ -432,24 +476,44 @@ def _restore_streamed_one(path, backward):
     data, meta = _open_verified(path)
     with data:
         core = backward.core
+        migrate = False
+        saved_mesh = have_mesh = None
         if "mesh" in meta:
-            # pre-mesh snapshots lack the key entirely (skip the check);
-            # new snapshots always record it, None meaning single-device.
-            # Checked BEFORE the generic stack-size check: a layout
-            # mismatch also changes the facet padding, and the operator
-            # should be told the cause, not the symptom.
+            # pre-mesh snapshots lack the key entirely (no migration —
+            # they restore unchanged onto the layout they were written
+            # on); new snapshots always record it, None meaning
+            # single-device. A layout mismatch is no longer a refusal:
+            # the elastic recovery ladder depends on restoring the last
+            # autosave onto whatever mesh SURVIVED, so mismatched
+            # snapshots take the gather→re-shard migration path — the
+            # saved arrays are already gathered host copies, so
+            # migration is a facet re-pad plus `_place` onto the new
+            # mesh, exact by construction (see `_migrate_stack`).
             from ..parallel.mesh import mesh_size
 
             saved_mesh = (meta["mesh"] or {}).get("n_devices", 1)
             have_mesh = mesh_size(backward._base.mesh)
-            if saved_mesh != have_mesh:
-                raise ValueError(
-                    f"Checkpoint was written on a {saved_mesh}-device "
-                    f"mesh layout; this session has {have_mesh} — "
-                    "restore onto the same sharding (facet padding and "
-                    "shard ownership depend on it)"
-                )
-        _check_meta(meta, core, backward.stack.n_total, "streamed_backward")
+            migrate = saved_mesh != have_mesh
+        _check_meta(
+            meta, core, backward.stack.n_total, "streamed_backward",
+            n_real=backward.stack.n_real if migrate else None,
+        )
+        n_real, n_total = backward.stack.n_real, backward.stack.n_total
+
+        def _stack(arr):
+            return _migrate_stack(arr, n_real, n_total) if migrate else arr
+
+        if migrate:
+            _metrics.count("ckpt.migrations")
+            _degrade.record(
+                "checkpoint", "migrate_layout",
+                f"{path!r} written on a {saved_mesh}-device layout; "
+                f"migrated onto {have_mesh} device(s)",
+            )
+            logger.warning(
+                "checkpoint %r: migrating %s-device layout onto %s "
+                "device(s)", path, saved_mesh, have_mesh,
+            )
         saved_res = meta.get("residency")
         is_sampled = backward._base.residency == "sampled"
         if (saved_res == "sampled") != is_sampled:
@@ -474,7 +538,7 @@ def _restore_streamed_one(path, backward):
                 )
             if meta.get("has_acc"):
                 backward._acc = backward._base._place(
-                    _load_array(data, meta, "acc", path)
+                    _stack(_load_array(data, meta, "acc", path))
                 )
             backward.processed = list(processed)
             return processed
@@ -498,7 +562,7 @@ def _restore_streamed_one(path, backward):
 
         device = backward._base.residency == "device"
         for key in meta["naf_keys"]:
-            rows = _load_array(data, meta, f"naf_{key}", path)
+            rows = _stack(_load_array(data, meta, f"naf_{key}", path))
             if device:
                 # facet-sharded on a mesh, plain device array otherwise
                 backward._naf[key] = backward._base._place(rows)

@@ -626,3 +626,128 @@ def test_precision_budget_table_matches_docs():
             f"docs/accuracy.md does not document the {setting} budget "
             f"{fmt(table[setting])}"
         )
+
+
+def _run_mesh_chaos(tmp_path, extra_args=(), timeout=540):
+    out = tmp_path / "BENCH_mesh_chaos.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip(),
+        BENCH_MESH_CHAOS_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "bench.py"),
+            "--mesh", "--chaos", *extra_args,
+        ],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    return summary, out
+
+
+def test_bench_mesh_chaos_smoke_leg(tmp_path):
+    """The `bench.py --mesh --chaos --smoke` drill, run exactly as the
+    driver would (fresh subprocess, 8 virtual CPU shards) — the
+    ISSUE-12 acceptance shape end-to-end: one of 8 shards killed
+    mid-stream, the layout re-planned to 7 survivors by the plan
+    compiler, the last autosave migrated across layouts (through a
+    bit-flipped newest generation), the stream resumed at the autosave
+    boundary, final facets BIT-identical to the undisturbed mesh run;
+    a stalled collective detected by the watchdog; the
+    ``mesh.recovery`` block schema-validated; and the
+    ``recovery_overhead`` sentinel in bench_compare tripped by a
+    doctored reference."""
+    summary, out = _run_mesh_chaos(tmp_path, extra_args=("--smoke",))
+    assert summary["mesh_chaos_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["bit_identical"] is True
+    assert summary["shards"] == "8->7"
+    assert summary["stalls_detected"] == 1
+
+    # re-validate the artifact out-of-process (the drill's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import (
+        validate_mesh_artifact,
+        validate_resilience_artifact,
+    )
+
+    record = json.loads(out.read_text())
+    assert validate_mesh_artifact(record) == []
+    assert validate_resilience_artifact(record) == []
+    rec = record["mesh"]["recovery"]
+    assert rec["events"] == 1
+    assert rec["shards_before"] == 8 and rec["shards_after"] == 7
+    # the survivor layout came from the plan compiler, priced
+    assert rec["replanned"]["facet_shards"] == 7
+    assert rec["replanned"]["collective_bytes_total"] > 0
+    assert rec["migrated"] is True and rec["subgrids_migrated"] > 0
+    assert rec["migrations"] >= 1
+    # generation fallback composed WITH the layout migration
+    assert rec["checkpoint_fallbacks"] >= 1
+    assert rec["kill_site"] == "mesh.shard_loss"
+    assert rec["watchdog"]["stalls_detected"] == 1
+    assert rec["recovery_wall_s"] > 0
+    assert 0 < rec["recovery_overhead"] < 10
+    assert rec["bit_identical"] is True
+    # zero-tolerance match audit: recovered == undisturbed, exactly
+    match = record["mesh"]["match"]
+    assert match["tolerance"] == 0.0
+    assert match["max_abs_diff"] == 0.0
+    res = record["resilience"]
+    assert res["resume_count"] == 1
+    assert res["retries"] >= 1 and res["retries_recovered"] >= 1
+    assert "shard_loss" in res["faults_by_kind"]
+    assert any(
+        d["site"] == "mesh" and d["action"] == "replan_survivors"
+        for d in res["degradations"]
+    )
+    # telemetry carries the recovery vocabulary
+    counters = record["telemetry"]["counters"]
+    assert counters["mesh.recovery.events"] == 1
+    assert counters["mesh.recovery.replans"] == 1
+    assert counters["ckpt.migrations"] >= 1
+    assert counters["watchdog.stalls"] >= 1
+    assert record["clean_run"]["fault_plan_installed"] is False
+
+    # --- the recovery-overhead sentinel (in-process: no extra spawn) --
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_mesh_chaos_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main([str(out), "--against", str(ref)]) == 0
+    # doctored 3x-faster recovery reference -> the sentinel must trip
+    doctored = json.loads(out.read_text())
+    doctored["mesh"]["recovery"]["recovery_overhead"] = (
+        rec["recovery_overhead"] / 3.0
+    )
+    doctored["value"] = record["value"]  # wall unchanged: isolate it
+    ref.write_text(json.dumps(doctored))
+    assert compare_main([str(out), "--against", str(ref)]) == 1
+
+
+@pytest.mark.slow
+def test_bench_mesh_chaos_full_drill(tmp_path):
+    """The full (non-smoke) elastic recovery drill at the 4k config —
+    the slow-gated rehearsal of the same contract at a scale where the
+    migrated checkpoint and spill entries are MBs, not KBs."""
+    summary, out = _run_mesh_chaos(tmp_path, timeout=1800)
+    assert summary["mesh_chaos"] == "ok", summary
+    assert summary["bit_identical"] is True
+    record = json.loads(out.read_text())
+    from swiftly_tpu.obs import (
+        validate_mesh_artifact,
+        validate_resilience_artifact,
+    )
+
+    assert validate_mesh_artifact(record) == []
+    assert validate_resilience_artifact(record) == []

@@ -368,10 +368,11 @@ def test_replay_overflow_raises_before_commit(cover):
     assert engine.ledger.version == v0
     assert engine.spill.complete is False
     assert engine.spill.patching is False
-    # and the stale feed refuses (incomplete-cache gate) instead of
-    # serving rows out of the destroyed stream
-    with pytest.raises(LookupError, match="mid-update"):
+    # and the feed refuses (the destroyed stream counts as evicted)
+    # instead of serving rows out of it
+    with pytest.raises(LookupError, match="no longer complete"):
         feed.lookup(sgs[0])
+    assert feed.evicted == 1
 
 
 # ---------------------------------------------------------------------------

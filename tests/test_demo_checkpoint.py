@@ -350,3 +350,160 @@ def test_mesh_restore_places_facet_sharded(tmp_path):
     np.testing.assert_allclose(
         np.asarray(bwd2.finish()), facets_ref, atol=1e-13
     )
+
+
+# Tiny mesh geometry (the dryrun parameter set, see test_mesh_engine):
+# 9 facets, so the padded stack differs on every layout below —
+# 16 rows on 8 shards, 14 on 7, 12 on 4, 9 on a single chip.
+MESH_PARAMS = dict(
+    W=8.0, fov=1.0, N=256, yB_size=96, yN_size=128, xA_size=56,
+    xM_size=64,
+)
+
+
+def test_cross_layout_migration_matrix(tmp_path):
+    """The elastic-recovery restore contract (ISSUE-12): a streamed
+    snapshot written on one layout restores onto ANY other — 8 -> 4,
+    8 -> 7, mesh -> single-chip and single-chip -> mesh — by migrating
+    the gathered facet stacks (real facets kept, shard padding
+    re-derived), and the resumed fold finishes BIT-identical because
+    the per-facet fold math is shard-local on every layout. A
+    bit-flipped newest generation composes: restore falls back a
+    generation AND migrates in the same call. Legacy pre-mesh
+    snapshots (no ``mesh`` meta key) still restore unchanged."""
+    from swiftly_tpu.mesh import (
+        MeshStreamedBackward,
+        MeshStreamedForward,
+        make_facet_mesh,
+    )
+    from swiftly_tpu.resilience import degrade
+    from swiftly_tpu.resilience.faults import corrupt_file
+    from swiftly_tpu.utils.checkpoint import (
+        checkpoint_generations,
+        restore_streamed_backward_state,
+        save_streamed_backward_state,
+    )
+
+    config = SwiftlyConfig(backend="jax", **MESH_PARAMS)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, SOURCES))
+        for fc in facet_configs
+    ]
+
+    def collect(fwd):
+        """The forward's column-group stream as reusable host bytes:
+        the SAME bytes feed every target layout below, which is what
+        makes cross-layout bit-identity a fair assertion."""
+        out = []
+        for per_col, group in fwd.stream_column_groups(subgrid_configs):
+            out.append((
+                [[sg for _, sg in col] for col in per_col],
+                np.asarray(group),
+                frozenset(
+                    (sg.off0, sg.off1) for col in per_col for _, sg in col
+                ),
+            ))
+        return out
+
+    def run(bwd, stream, skip=()):
+        skip = set(skip)
+        for cols, group, keys in stream:
+            if skip and keys <= skip:
+                continue
+            bwd.add_subgrid_group(cols, group)
+        return np.asarray(bwd.finish())
+
+    mesh8 = make_facet_mesh(n_devices=8)
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh8)
+    mfwd.col_group = 3  # 5 columns -> 2 groups (save boundary = group 0)
+    stream8 = collect(mfwd)
+    assert len(stream8) == 2
+    want = run(
+        MeshStreamedBackward(config, facet_configs, mesh=mesh8), stream8
+    )
+
+    # group-0-only snapshot on the 8-shard layout
+    bwd_part = MeshStreamedBackward(config, facet_configs, mesh=mesh8)
+    bwd_part.add_subgrid_group(stream8[0][0], stream8[0][1])
+    ck = tmp_path / "mesh8.npz"
+    save_streamed_backward_state(ck, bwd_part)
+    done0 = set(bwd_part.processed)
+
+    # 8 -> 4, 8 -> 7, mesh -> single-chip: migrate + resume, all exact
+    degrade.reset()
+    targets = [
+        MeshStreamedBackward(
+            config, facet_configs, mesh=make_facet_mesh(n_devices=4)
+        ),
+        MeshStreamedBackward(
+            config, facet_configs, mesh=make_facet_mesh(n_devices=7)
+        ),
+        StreamedBackward(config, facet_configs, residency="sampled"),
+    ]
+    for bwd_t in targets:
+        processed = restore_streamed_backward_state(ck, bwd_t)
+        assert set(processed) == done0
+        np.testing.assert_array_equal(
+            run(bwd_t, stream8, skip=processed), want
+        )
+    assert [
+        d["action"] for d in degrade.events()
+        if d["site"] == "checkpoint"
+    ] == ["migrate_layout"] * 3
+
+    # single-chip -> mesh: a single-chip snapshot ("mesh": None in the
+    # meta) grows onto 8 shards — same contract, opposite direction
+    fwd1 = StreamedForward(config, facet_tasks, residency="device")
+    fwd1.col_group = 3
+    stream1 = collect(fwd1)
+    want1 = run(
+        StreamedBackward(config, facet_configs, residency="sampled"),
+        stream1,
+    )
+    bwd1 = StreamedBackward(config, facet_configs, residency="sampled")
+    bwd1.add_subgrid_group(stream1[0][0], stream1[0][1])
+    ck1 = tmp_path / "single.npz"
+    save_streamed_backward_state(ck1, bwd1)
+    bwd_m = MeshStreamedBackward(config, facet_configs, mesh=mesh8)
+    processed = restore_streamed_backward_state(ck1, bwd_m)
+    assert set(processed) == set(bwd1.processed)
+    np.testing.assert_array_equal(
+        run(bwd_m, stream1, skip=processed), want1
+    )
+
+    # corrupt newest generation + layout change in ONE restore: fall
+    # back to the older generation, then migrate it
+    bwd_part.add_subgrid_group(stream8[1][0], stream8[1][1])
+    save_streamed_backward_state(ck, bwd_part)  # gen 2: fully fed
+    assert len(checkpoint_generations(ck)) == 2
+    corrupt_file(str(ck))
+    degrade.reset()
+    bwd4 = MeshStreamedBackward(
+        config, facet_configs, mesh=make_facet_mesh(n_devices=4)
+    )
+    processed = restore_streamed_backward_state(ck, bwd4)
+    assert set(processed) == done0  # the OLDER generation's ledger
+    acts = [
+        d["action"] for d in degrade.events()
+        if d["site"] == "checkpoint"
+    ]
+    assert "fallback_generation" in acts and "migrate_layout" in acts
+    np.testing.assert_array_equal(
+        run(bwd4, stream8, skip=processed), want
+    )
+
+    # legacy pre-mesh snapshot (no "mesh" key): restores unchanged
+    # onto the layout it was written on — never migrated
+    legacy = tmp_path / "legacy.npz"
+    legacy.write_bytes(ck1.read_bytes())
+    _rewrite_meta(legacy, lambda meta: meta.pop("mesh"))
+    degrade.reset()
+    bwd_l = StreamedBackward(config, facet_configs, residency="sampled")
+    processed = restore_streamed_backward_state(legacy, bwd_l)
+    assert set(processed) == set(bwd1.processed)
+    assert degrade.events() == []
+    np.testing.assert_array_equal(
+        run(bwd_l, stream1, skip=processed), want1
+    )

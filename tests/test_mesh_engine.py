@@ -157,13 +157,15 @@ def test_mesh_row_slab_concat_equals_whole(cover, single_chip):
     np.testing.assert_allclose(whole, ref_facets, atol=1e-12)
 
 
-def test_mesh_checkpoint_records_and_enforces_layout(cover, tmp_path):
+def test_mesh_checkpoint_records_and_migrates_layout(cover, tmp_path):
     """Checkpoint meta records the mesh layout; restore onto the SAME
-    sharding resumes to a bit-identical result, restore onto a
-    different layout (single-chip session) refuses loudly."""
+    sharding resumes to a bit-identical result; restore onto a
+    DIFFERENT layout (here a single-chip session) migrates the facet
+    stacks — gather, drop padding, re-pad, re-place — and resumes to
+    the same bit-identical result (the elastic-recovery contract)."""
     import json
-    import zlib
 
+    from swiftly_tpu.resilience import degrade
     from swiftly_tpu.utils.checkpoint import (
         restore_streamed_backward_state,
         save_streamed_backward_state,
@@ -206,12 +208,29 @@ def test_mesh_checkpoint_records_and_enforces_layout(cover, tmp_path):
         )
     np.testing.assert_array_equal(bwd_res.finish(), want)
 
-    # a single-chip session must not silently adopt mesh-sharded state
+    # a single-chip session MIGRATES the mesh-sharded snapshot instead
+    # of refusing: real facets sliced out of the shard padding, the
+    # resumed fold is shard-local per-facet math so the finish is
+    # byte-identical across the layout change
+    degrade.reset()
     bwd_single = StreamedBackward(
         config, facet_configs, residency="sampled"
     )
-    with pytest.raises(ValueError, match="mesh"):
-        restore_streamed_backward_state(ck, bwd_single)
+    processed_s = restore_streamed_backward_state(ck, bwd_single)
+    assert processed_s == bwd.processed
+    assert any(
+        d["site"] == "checkpoint" and d["action"] == "migrate_layout"
+        for d in degrade.events()
+    )
+    done = set(processed_s)
+    for per_col, group in mfwd.stream_column_groups(subgrid_configs):
+        keys = [(sg.off0, sg.off1) for col in per_col for _, sg in col]
+        if all(k in done for k in keys):
+            continue
+        bwd_single.add_subgrid_group(
+            [[sg for _, sg in col] for col in per_col], group
+        )
+    np.testing.assert_array_equal(bwd_single.finish(), want)
 
     # corrupt-meta snapshots still classify as corruption, not layout
     # mismatch (the mesh check must not mask CRC failures): flip a byte
@@ -225,6 +244,92 @@ def test_mesh_checkpoint_records_and_enforces_layout(cover, tmp_path):
             tmp_path / "torn.npz",
             MeshStreamedBackward(config, facet_configs, mesh=mesh),
         )
+
+
+def test_mesh_elastic_recovery_survives_shard_loss(cover, tmp_path):
+    """The elastic rung end-to-end at the tiny geometry (the ISSUE-12
+    tentpole, consolidated): a ``mesh.shard_loss`` injected mid-pass
+    raises `ShardLostError`, `run_elastic_pass` re-plans 8 -> 7 on the
+    survivors via the plan compiler (priced, not guessed), rebuilds
+    both engines, migrates the last autosave across layouts, resumes
+    at the autosave boundary — final facets BIT-identical to the
+    undisturbed mesh run; the report carries the artifact-block shape;
+    a second loss past ``max_recoveries`` re-raises."""
+    from swiftly_tpu.mesh import run_elastic_pass, survivor_mesh
+    from swiftly_tpu.plan import PlanInputs
+    from swiftly_tpu.resilience import (
+        FaultPlan,
+        ShardLostError,
+        degrade,
+        faults,
+    )
+    from swiftly_tpu.utils.spill import SpillCache
+
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    mfwd.col_group = 3  # 5 columns -> 2 groups: autosave, then kill
+
+    # undisturbed reference + recorded spill (pass 1 records the
+    # stream; the elastic pass below is cache-fed, so the replayed
+    # bytes are layout-independent and recovery can be exact)
+    spill = SpillCache(budget_bytes=1e9)
+    bwd_ref = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, bwd_ref, subgrid_configs, spill=spill)
+    want = bwd_ref.finish()
+
+    degrade.reset()
+    ck = tmp_path / "elastic.npz"
+    bwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    # the plan is installed for the elastic pass only, so site call
+    # counters start at 0: call 1 = the SECOND yielded group, after
+    # group 0's autosave landed
+    plan = FaultPlan(faults=[
+        {"site": "mesh.shard_loss", "kind": "shard_loss", "at": 1},
+    ])
+    inputs = PlanInputs.from_cover(
+        config, facet_configs, subgrid_configs, n_devices=N_SHARDS
+    )
+    with faults.active(plan):
+        fwd2, bwd, report = run_elastic_pass(
+            mfwd, bwd, subgrid_configs, spill, str(ck),
+            plan_inputs=inputs,
+        )
+    assert plan.stats()["total"] == 1
+    np.testing.assert_array_equal(bwd.finish(), want)
+
+    # the report is artifact-block shaped and priced from the compiler
+    assert report["events"] == 1
+    assert report["shards_before"] == N_SHARDS
+    assert report["shards_after"] == N_SHARDS - 1
+    info = report["recoveries"][0]
+    assert info["detected_via"] == "ShardLostError"
+    assert info["replanned"]["facet_shards"] == N_SHARDS - 1
+    assert info["migrated"] and info["subgrids_migrated"] > 0
+    assert report["recovery_wall_s"] > 0
+    assert any(
+        d["site"] == "mesh" and d["action"] == "replan_survivors"
+        for d in degrade.events()
+    )
+    # the rebuilt engines live on the 7-shard survivor fabric
+    assert len(list(fwd2.mesh.devices.flat)) == N_SHARDS - 1
+    assert len(list(bwd.mesh.devices.flat)) == N_SHARDS - 1
+
+    # a loss past max_recoveries is an outage, not a degradation
+    plan2 = FaultPlan(faults=[
+        {"site": "mesh.shard_loss", "kind": "shard_loss", "at": 0},
+    ])
+    with faults.active(plan2), pytest.raises(ShardLostError):
+        run_elastic_pass(
+            fwd2, MeshStreamedBackward(
+                config, facet_configs, mesh=fwd2.mesh
+            ),
+            subgrid_configs, spill, str(tmp_path / "e2.npz"),
+            plan_inputs=inputs, max_recoveries=0,
+        )
+
+    # survivor_mesh bounds-checks the lost shard index
+    with pytest.raises(ValueError, match="out of range"):
+        survivor_mesh(mesh, lost_shard=N_SHARDS)
 
 
 def test_plan_mesh_layout_and_validators(cover):
@@ -281,6 +386,23 @@ def test_plan_mesh_layout_and_validators(cover):
         MeshStreamedForward(
             config, facet_tasks, layout=wrong, mesh=mesh
         )
+
+    # the operator window on the elastic ladder: plan_explain --devices
+    # prints the re-planned layouts at N-1 and N/2 survivors
+    import contextlib
+    import io
+
+    from scripts.plan_explain import main as explain_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert explain_main(
+            ["--config", "64k", "--devices", "8"]
+        ) == 0
+    report = buf.getvalue()
+    assert "degraded layouts" in report
+    assert "(one shard lost)" in report
+    assert "(half the mesh lost)" in report
 
 
 @pytest.mark.slow

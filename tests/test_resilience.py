@@ -554,3 +554,70 @@ def test_degrade_ledger_records_and_resets():
     ]
     degrade.reset()
     assert degrade.events() == []
+
+
+def test_shard_loss_kind_and_watchdog():
+    """The ISSUE-12 fault vocabulary: the ``shard_loss`` kind raises a
+    catchable `ShardLostError` that is NOT transient (a dead shard
+    cannot be retried back — it must walk the mesh recovery ladder);
+    the collective watchdog is off by default (direct call, zero
+    overhead), obeys ``SWIFTLY_COLLECTIVE_TIMEOUT_S``, converts a hung
+    collective into `CollectiveStalledError` (itself a shard loss),
+    and re-raises worker exceptions unchanged."""
+    from swiftly_tpu.resilience import (
+        CollectiveStalledError,
+        ShardLostError,
+        collective_timeout_s,
+        watch_collective,
+    )
+
+    plan = FaultPlan(
+        faults=[{"site": "s", "kind": "shard_loss", "at": 0}]
+    )
+    with faults.active(plan):
+        with pytest.raises(ShardLostError, match="injected shard loss"):
+            fault_point("s")
+    assert plan.stats()["by_kind"] == {"shard_loss": 1}
+    # catchable (RuntimeError), NOT transient, NOT a WorkerKilled tear
+    assert issubclass(ShardLostError, RuntimeError)
+    assert not is_transient(ShardLostError("gone"))
+    assert not issubclass(ShardLostError, WorkerKilled)
+    assert issubclass(CollectiveStalledError, ShardLostError)
+
+    # knob parsing: unset/empty/garbage/non-positive all mean OFF
+    assert collective_timeout_s(env={}) is None
+    assert collective_timeout_s(
+        env={"SWIFTLY_COLLECTIVE_TIMEOUT_S": ""}
+    ) is None
+    assert collective_timeout_s(
+        env={"SWIFTLY_COLLECTIVE_TIMEOUT_S": "soon"}
+    ) is None
+    assert collective_timeout_s(
+        env={"SWIFTLY_COLLECTIVE_TIMEOUT_S": "0"}
+    ) is None
+    assert collective_timeout_s(
+        env={"SWIFTLY_COLLECTIVE_TIMEOUT_S": "2.5"}
+    ) == 2.5
+
+    # disabled: the fn runs on the calling thread, result passes through
+    assert watch_collective(lambda: 41 + 1, "t.direct") == 42
+
+    # enabled + fast fn: result passes through the worker thread
+    assert watch_collective(
+        lambda: "ok", "t.fast", timeout_s=5.0
+    ) == "ok"
+
+    # enabled + hung fn: the stall surfaces as a DETECTED shard loss
+    import time as _time
+
+    with pytest.raises(CollectiveStalledError, match="t.slow"):
+        watch_collective(
+            lambda: _time.sleep(2.0), "t.slow", timeout_s=0.05
+        )
+
+    # worker exceptions re-raise unchanged (not wrapped as a stall)
+    def boom():
+        raise ValueError("inner failure")
+
+    with pytest.raises(ValueError, match="inner failure"):
+        watch_collective(boom, "t.boom", timeout_s=5.0)

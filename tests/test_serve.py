@@ -465,14 +465,15 @@ def test_cache_feed_hit_and_eviction_fallback(cover):
         )
 
     spill.reset()  # forced eviction: the cache is no longer complete,
-    # so the feed refuses up-front (stale gate) instead of serving a
-    # dangling index — either way a LookupError, and compute serves
+    # so the feed refuses up-front (counted as evictions — the stream
+    # is gone, not mid-update) and compute serves
     reqs2 = svc.serve(col0)
     _assert_all_ok(reqs2)
     assert all(r.result.path in ("coalesced", "retry") for r in reqs2)
     st = svc.stats()
     assert st["cache_fallbacks"] == len(col0)
-    assert feed.stale == len(col0)
+    assert feed.evicted == len(col0)
+    assert feed.stale == 0
     for sg, req in zip(col0, reqs2):
         np.testing.assert_array_equal(
             np.asarray(req.result.data),
