@@ -1646,14 +1646,30 @@ def fleet_bench(smoke_mode=False):
        replica to per-request dispatch, then hysteresis steps back
        down. (The signal is forced so the drill is deterministic; the
        organic signal path is pinned by tests/test_fleet.py.)
+    5. **autoscale** — a sustained zipf burst under a forced-high
+       journey signal must scale the fleet out through the
+       `serve.FleetAutoscaler` (each newcomer serves a `cache` fabric
+       feed VIEW — an L1 over the one resident stream, never a copy),
+       then a forced-low signal drains the extras back through the
+       zero-loss retire path; a final clean window pins p99 where the
+       *before* phase left it.
+
+    The whole fleet serves ONE recorded subgrid stream through the
+    shared cache fabric (`cache.SharedStreamTier` over the
+    `delta.IncrementalForward` recording): per-replica hot-row L1s
+    (sized by `plan.price_cache_tier`'s break-even) over a single
+    versioned spill-backed L2 — the artifact's ``cache`` block asserts
+    exactly one resident stream copy and a >= 10x QPS-equivalent over
+    the timed single-service compute baseline.
 
     Every served result is audited BIT-IDENTICAL against per-request
-    `get_subgrid_task` on a fresh forward — failover and hedging must
-    never change an answer. The artifact's ``fleet`` block (validated
-    by `obs.validate_fleet_artifact`) records per-replica QPS, the
-    failover/hedge/brownout counters, the victim's full breaker cycle
-    and the p99 before/during/after windows; with ``--smoke`` the
-    drill outcomes are asserted and the leg exits nonzero on any
+    `get_subgrid_task` on a fresh forward — failover, hedging and the
+    cache fabric must never change an answer. The artifact's ``fleet``
+    and ``cache`` blocks (validated by `obs.validate_fleet_artifact`)
+    record per-replica QPS, the failover/hedge/brownout/autoscale
+    counters, fabric hit/miss/dedup stats, the victim's full breaker
+    cycle and the p99 before/during/after windows; with ``--smoke``
+    the drill outcomes are asserted and the leg exits nonzero on any
     problem (wired into tier-1 via tests/test_bench_smoke.py).
     """
     import jax
@@ -1675,6 +1691,7 @@ def fleet_bench(smoke_mode=False):
     from swiftly_tpu.serve import (
         AdmissionQueue,
         CoalescingScheduler,
+        FleetAutoscaler,
         ServeFleet,
         SubgridService,
     )
@@ -1715,7 +1732,7 @@ def fleet_bench(smoke_mode=False):
         for fc in facet_configs
     ]
 
-    def replica_factory(rid):
+    def replica_factory(rid, feed):
         fwd = SwiftlyForward(
             config, facet_tasks, lru_forward=2, queue_size=64
         )
@@ -1724,21 +1741,40 @@ def fleet_bench(smoke_mode=False):
             queue=AdmissionQueue(max_depth=max_depth),
             scheduler=CoalescingScheduler(max_batch=max_batch),
             max_retries=2,
+            cache_feed=feed,
         )
 
     # admission costing from the unified plan compiler: the fleet's
     # per-request / per-column byte model is the compiled plan's serve
     # block (no cap here — the drill's phases must admit everything;
     # the pricing lands in the artifact's admission stats)
-    from swiftly_tpu.plan import PlanInputs, compile_plan
+    from swiftly_tpu.plan import PlanInputs, compile_plan, price_cache_tier
 
-    fleet_plan = compile_plan(
-        PlanInputs.from_cover(
-            config, facet_configs, subgrid_configs,
-            max_batch=max_batch,
-        ),
-        mode="streamed",
+    plan_inputs = PlanInputs.from_cover(
+        config, facet_configs, subgrid_configs, max_batch=max_batch,
     )
+    fleet_plan = compile_plan(plan_inputs, mode="streamed")
+
+    # ONE recorded stream for the whole fleet: record the subgrid
+    # stream once through the incremental engine, then front it with
+    # the shared cache fabric — each replica gets a hot-row L1 VIEW
+    # over the single resident spill-backed L2, sized by the plan
+    # compiler's priced break-even
+    from swiftly_tpu.delta import IncrementalForward
+    from swiftly_tpu.utils.spill import SpillCache, spill_budget_bytes
+
+    engine = IncrementalForward(
+        config, facet_tasks,
+        SpillCache(budget_bytes=spill_budget_bytes()),
+    )
+    engine.record(subgrid_configs)
+    l1_env = int(os.environ.get("BENCH_FLEET_L1_ROWS", "0"))
+    cache_plan = price_cache_tier(
+        plan_inputs, replicas=n_replicas,
+        l1_rows=l1_env or None, zipf_s=zipf_s,
+    )
+    fabric = engine.fabric(l1_rows=cache_plan.l1_rows)
+
     fleet = ServeFleet(
         replica_factory, n_replicas,
         lease_interval_s=0.02, miss_suspect=3, miss_revoke=6,
@@ -1752,6 +1788,7 @@ def fleet_bench(smoke_mode=False):
         failover_backoff_s=0.01, seed=seed,
         request_bytes=fleet_plan.serve.request_bytes,
         column_bytes=fleet_plan.serve.column_bytes,
+        fabric=fabric, drain_timeout_s=20.0,
     )
 
     # one shared workload per phase (same seed -> identical request
@@ -1771,6 +1808,20 @@ def fleet_bench(smoke_mode=False):
             warm_fwd.get_subgrid_tasks([hot_col[0]] * b)
             b *= 2
         warm_fwd.get_subgrid_task(hot_col[0])
+
+    # single-service compute baseline: one replica-shaped service with
+    # NO cache feed, timed over a slice of the same zipf workload — the
+    # honest denominator for the fabric's QPS-equivalence claim
+    solo = replica_factory(-1, None)
+    solo.serve(workload[:2], priority=1)  # warm its dispatch path
+    solo_n = min(24, len(workload))
+    t_solo = time.time()
+    solo_reqs = solo.serve(workload[:solo_n], priority=1)
+    solo_wall = time.time() - t_solo
+    solo_ok = sum(
+        1 for r in solo_reqs if r.result is not None and r.result.ok
+    )
+    single_service_qps = (solo_ok / solo_wall) if solo_wall else 0.0
 
     from swiftly_tpu.obs import trace as otrace
 
@@ -1828,6 +1879,18 @@ def fleet_bench(smoke_mode=False):
         rid for rid, r in fleet.replicas.items() if r.dead
     ]
     victim = victims[0] if victims else None
+    # the fabric makes the kill window cache-fast: the burst drains in
+    # tens of milliseconds, well inside the monitor's miss_revoke
+    # horizon — wait for DETECTION (missed heartbeats -> revocation,
+    # which trips the breaker) before restoring, or the drill restores
+    # a victim the health plane never got to condemn
+    if victim is not None:
+        deadline = time.time() + 10.0
+        while (
+            not fleet.replica(victim).lease.revoked
+            and time.time() < deadline
+        ):
+            time.sleep(0.005)
 
     # -- phase 3: restore + recovery window -------------------------------
     if victim is not None:
@@ -1897,28 +1960,103 @@ def fleet_bench(smoke_mode=False):
         for r in fleet.replicas.values()
     )
 
+    # -- phase 5: sustained zipf + autoscaler (scale out, drain back) -----
+    # the elastic drill: a sustained burst under a forced-high journey
+    # signal must scale the fleet out (each newcomer is a fabric feed
+    # VIEW — an L1, not a stream copy), then a forced-low signal must
+    # drain the extra replicas back through the zero-loss path. The
+    # signals are forced for determinism, exactly like the brownout
+    # rungs above; the organic paths are pinned by tests/test_fleet.py.
+    fleet.drain(timeout=60.0)
+    scaler = FleetAutoscaler(
+        fleet, min_replicas=n_replicas, max_replicas=n_replicas + 2,
+        up_share=0.55, down_share=0.15, min_queue_depth=2,
+        hold_ticks=2, cooldown_s=0.2,
+    )
+    fleet.autoscaler = scaler
+    fleet.queue_share = lambda window=256: 0.9  # instance override
+    as_phase = []
+    t_as = time.time()
+    for _rep in range(3):
+        for sg in workload:
+            fr = fleet.submit(sg, priority=1)
+            as_phase.append((sg, fr))
+            tracked.append((sg, fr))
+    deadline = time.time() + 15.0
+    while (
+        fleet._counts["scale_outs"] < 1 and time.time() < deadline
+    ):
+        time.sleep(0.005)
+    if not fleet.drain(timeout=120.0):
+        log.error("autoscale phase did not drain")
+    as_wall = time.time() - t_as
+    # drain back: forced-low signal, empty queue -> the autoscaler
+    # retires the newcomers one cooldown at a time
+    fleet.queue_share = lambda window=256: 0.0
+    deadline = time.time() + 20.0
+    while (
+        len(fleet.replicas) > n_replicas and time.time() < deadline
+    ):
+        time.sleep(0.01)
+    del fleet.queue_share
+    as_ok = sum(
+        1 for _sg, fr in as_phase
+        if fr.result is not None and fr.result.ok
+    )
+    autoscale_phase_rps = (as_ok / as_wall) if as_wall else 0.0
+    # post-churn clean window: the same request multiset as the
+    # *before* phase — elastic churn must leave p99 where it found it
+    _phase_e, lat_elastic = run_phase("elastic_after")
+    p99_elastic = _lat_quantile_ms(lat_elastic, 0.99)
+
     fleet.drain(timeout=60.0)
     wall = time.time() - t0
     stats = fleet.stats(wall_s=wall)
     fleet.stop()
     fleet_span.__exit__(None, None, None)
 
-    # -- bit-identity audit: every served result vs per-request compute
-    # on a FRESH forward — failover/hedging must never change answers
+    # -- bit-identity audit: every served result vs a FRESH deterministic
+    # reference for ITS serving path — failover/hedging/dedup must never
+    # change answers. Cache-path rows come from the recorded stream
+    # (the streamed column-group program), compute-path results from the
+    # stacked per-request program; the two differ in reduction order at
+    # float noise, so each path is audited BIT-identical against its own
+    # freshly re-run program, and a cross-program allclose guard catches
+    # wrong-row serving (an index/L1 mix-up is an O(1) relative error,
+    # not an O(1e-10) reduction-order one)
     fwd_ref = SwiftlyForward(config, facet_tasks, lru_forward=2,
                              queue_size=64)
+    ref_engine = IncrementalForward(
+        config, facet_tasks,
+        SpillCache(budget_bytes=spill_budget_bytes()),
+    )
+    ref_engine.record(subgrid_configs)
+    stream_ref = ref_engine.feed()
     ref_cache = {}
-    checked = mismatches = 0
+    checked = mismatches = cross_mismatches = 0
     for sg, fr in tracked:
         res = fr.result
         if res is None or not res.ok:
             continue
         key = (sg.off0, sg.off1)
         if key not in ref_cache:
-            ref_cache[key] = np.asarray(fwd_ref.get_subgrid_task(sg))
+            srow = stream_ref.lookup(sg)
+            ref_cache[key] = (
+                np.asarray(fwd_ref.get_subgrid_task(sg)),
+                None if srow is None else np.asarray(srow),
+            )
+        compute_ref, cache_ref = ref_cache[key]
+        expected = (
+            cache_ref
+            if res.path == "cache" and cache_ref is not None
+            else compute_ref
+        )
+        got = np.asarray(res.data)
         checked += 1
-        if not np.array_equal(np.asarray(res.data), ref_cache[key]):
+        if not np.array_equal(got, expected):
             mismatches += 1
+        if not np.allclose(got, compute_ref, rtol=1e-4, atol=1e-8):
+            cross_mismatches += 1
 
     n_ok = sum(
         1 for _sg, fr in tracked
@@ -1935,12 +2073,17 @@ def fleet_bench(smoke_mode=False):
         for r in brownout_shed
         if r.result is not None and r.result.retry_after_s is not None
     ]
+    cache_stats = fabric.stats()
+    qps_ratio = (
+        autoscale_phase_rps / single_service_qps
+        if single_service_qps else 0.0
+    )
     record = {
         "metric": (
             f"{name} self-healing serve fleet "
             f"({len(tracked)} zipf requests over {n_cols} columns, "
-            f"{n_replicas} replicas, kill+restore drill, planar f32, "
-            f"{platform})"
+            f"{n_replicas} replicas + cache fabric, kill+restore+"
+            f"autoscale drill, planar f32, {platform})"
         ),
         "value": round(wall, 4),
         "unit": "s",
@@ -1952,7 +2095,11 @@ def fleet_bench(smoke_mode=False):
         "n_requests": stats["requests"],
         "n_served": stats["served"],
         "n_shed": stats["shed"],
-        "bit_identical": {"checked": checked, "mismatches": mismatches},
+        "bit_identical": {
+            "checked": checked,
+            "mismatches": mismatches,
+            "cross_program_mismatches": cross_mismatches,
+        },
         "fleet": {
             "n_replicas": n_replicas,
             "victim": victim,
@@ -1985,6 +2132,24 @@ def fleet_bench(smoke_mode=False):
                 ],
             },
             "per_replica": stats["per_replica"],
+            "stream_copies": stats["stream_copies"],
+            "scale_outs": stats["scale_outs"],
+            "drains": stats["drains"],
+            "retired": stats["retired"],
+            "autoscale": stats.get("autoscale"),
+            "p99_elastic_ms": p99_elastic,
+        },
+        "cache": {
+            **cache_stats,
+            "plan": {
+                "l1_rows": cache_plan.l1_rows,
+                "break_even_l1_rows": cache_plan.break_even_l1_rows,
+                "expected_wall_s": round(cache_plan.expected_wall_s, 9),
+                "coeffs_source": cache_plan.coeffs_source,
+            },
+            "single_service_qps": round(single_service_qps, 2),
+            "autoscale_phase_rps": round(autoscale_phase_rps, 2),
+            "qps_equivalent_ratio": round(qps_ratio, 2),
         },
         "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
         "n_subgrids_cover": len(subgrid_configs),
@@ -2023,6 +2188,12 @@ def fleet_bench(smoke_mode=False):
                 f"bit-identity audit failed: {mismatches} mismatches, "
                 f"{checked}/{n_ok} checked"
             )
+        if cross_mismatches:
+            problems.append(
+                f"cross-program audit failed: {cross_mismatches} "
+                "cache-path results diverge from per-request compute "
+                "beyond reduction-order noise (wrong-row serving)"
+            )
         if stats["failovers"] < 1:
             problems.append("the kill produced no failover")
         for state in ("open", "half_open", "closed"):
@@ -2059,6 +2230,50 @@ def fleet_bench(smoke_mode=False):
             problems.append(
                 "brownout recovery did not restore max_batch"
             )
+        # cache fabric + autoscale drill outcomes
+        if cache_stats["resident_stream_copies"] != 1:
+            problems.append(
+                f"fabric reports {cache_stats['resident_stream_copies']}"
+                " resident stream copies, not 1"
+            )
+        if stats["stream_copies"] != 1:
+            problems.append(
+                f"fleet reports stream_copies={stats['stream_copies']}"
+                " with a fabric attached"
+            )
+        if len(fleet.replicas) < 3:
+            problems.append(
+                f"fleet ended with {len(fleet.replicas)} replicas "
+                "(need >= 3 sharing the one resident stream)"
+            )
+        if cache_stats["hit_ratio"] < 0.5:
+            problems.append(
+                f"fabric hit_ratio {cache_stats['hit_ratio']} < 0.5: "
+                "the drill should serve mostly from the shared cache"
+            )
+        if stats["scale_outs"] < 1:
+            problems.append(
+                "autoscaler never scaled out under the sustained burst"
+            )
+        if stats["drains"] < 1:
+            problems.append(
+                "autoscaler never drained the scaled-out replica back"
+            )
+        if len(fleet.replicas) != n_replicas:
+            problems.append(
+                f"fleet did not drain back to {n_replicas} replicas "
+                f"(has {len(fleet.replicas)})"
+            )
+        if qps_ratio < 10.0:
+            problems.append(
+                f"autoscale-phase throughput is only {qps_ratio:.1f}x "
+                "the single-service compute QPS (need >= 10x)"
+            )
+        if p99_before and p99_elastic > 1.5 * p99_before:
+            problems.append(
+                f"p99 not held through elastic churn: {p99_elastic}ms "
+                f"vs {p99_before}ms before (> 1.5x)"
+            )
     with open(out_path, "w") as fh:
         json.dump(record, fh, indent=2)
     if smoke_mode:
@@ -2075,6 +2290,11 @@ def fleet_bench(smoke_mode=False):
                     "p99_before_ms": p99_before,
                     "p99_after_ms": p99_after,
                     "breaker_cycle": victim_cycle,
+                    "stream_copies": stats["stream_copies"],
+                    "hit_ratio": cache_stats["hit_ratio"],
+                    "scale_outs": stats["scale_outs"],
+                    "drains": stats["drains"],
+                    "qps_equivalent_ratio": round(qps_ratio, 2),
                     "problems": problems,
                 }
             ),

@@ -44,6 +44,18 @@ artifact) and exits non-zero when a leg regressed:
   (lowest) same-platform reference — an elastic-recovery path that
   quietly slows down (slower re-plan, heavier migration) is a
   time-to-recover regression even when the clean-path wall holds.
+* **cache hit ratio** — for fleet legs with the shared cache fabric
+  (``--fleet`` artifacts since the fabric PR): the ``cache.hit_ratio``
+  metric (served-from-cache share of all lookups, higher is better)
+  more than the threshold below the best same-platform reference — a
+  fabric that quietly degrades toward recompute-per-request is a
+  serving-cost regression even when throughput briefly holds.
+* **stream copies** — same legs: ``fleet.stream_copies`` (resident
+  copies of the recorded stream across the fleet, lower is better)
+  above the best (lowest) reference — the fabric's whole point is ONE
+  copy for N replicas, so a topology change that silently reverts to
+  per-replica copies trips the sentinel regardless of threshold
+  arithmetic (any increase over the reference is a regression).
 * **precision RMS** — for accuracy legs (``--precision`` artifacts):
   the ``rms_vs_dft_oracle`` metric (lower is better) more than the
   threshold above the best (lowest) same-platform reference — a
@@ -157,7 +169,8 @@ def compare(latest_records, reference_records, threshold=0.2):
         bucket = refs.setdefault(
             (key, leg_platform(rec)),
             {"wall": None, "mfu": None, "p99": None, "rps": None,
-             "se": None, "dse": None, "rms": None, "ro": None, "n": 0},
+             "se": None, "dse": None, "rms": None, "ro": None,
+             "chr": None, "sc": None, "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -195,6 +208,14 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(ro, (int, float)) and ro > 0:
             if bucket["ro"] is None or ro < bucket["ro"]:
                 bucket["ro"] = ro
+        chr_ = (rec.get("cache") or {}).get("hit_ratio")
+        if isinstance(chr_, (int, float)) and chr_ > 0:
+            if bucket["chr"] is None or chr_ > bucket["chr"]:
+                bucket["chr"] = chr_
+        sc = (rec.get("fleet") or {}).get("stream_copies")
+        if isinstance(sc, (int, float)) and sc > 0:
+            if bucket["sc"] is None or sc < bucket["sc"]:
+                bucket["sc"] = sc
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -322,6 +343,32 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"recovery overhead {ro:.4g}x is "
                     f"{100 * (ro / ref['ro'] - 1):.1f}% above best "
                     f"reference {ref['ro']:.4g}x"
+                )
+        # fleet cache-fabric legs: hit ratio (higher is better) +
+        # resident stream copies (lower is better; ANY increase over
+        # the reference regresses the one-copy-for-N-replicas claim)
+        chr_ = (rec.get("cache") or {}).get("hit_ratio")
+        if isinstance(chr_, (int, float)) and chr_ > 0:
+            verdict["cache_hit_ratio"] = chr_
+            verdict["ref_cache_hit_ratio"] = ref["chr"]
+            if (
+                ref["chr"] is not None
+                and chr_ < ref["chr"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"cache hit ratio {chr_:.4g} is "
+                    f"{100 * (1 - chr_ / ref['chr']):.1f}% below best "
+                    f"reference {ref['chr']:.4g}"
+                )
+        sc = (rec.get("fleet") or {}).get("stream_copies")
+        if isinstance(sc, (int, float)) and sc > 0:
+            verdict["stream_copies"] = sc
+            verdict["ref_stream_copies"] = ref["sc"]
+            if ref["sc"] is not None and sc > ref["sc"]:
+                verdict["problems"].append(
+                    f"{sc:g} resident stream copies vs "
+                    f"{ref['sc']:g} in the best reference — the "
+                    "fabric's single-resident-copy claim regressed"
                 )
         # precision legs: accuracy sentinel (lower is better)
         rms = rec.get("rms_vs_dft_oracle")

@@ -28,6 +28,13 @@ predicted to hide the feed entirely (the h2d/compute overlap).
 ``--feed-group`` forces passes-per-feed, mirroring bench's
 ``BENCH_BWD_FEED_GROUP``.
 
+``--cache`` switches to the serve cache-fabric tier table
+(`plan.price_cache_tier`): for ``--replicas`` N over one resident
+recorded stream, the priced per-request wall of a per-replica L1 hit
+vs an L2 (spill) read vs a recompute, scanned over candidate L1 sizes
+with the break-even size marked — the fabric's answer to "how big
+should each replica's hot-row cache be".
+
 With ``--devices N`` (N > 1) the report ends with the DEGRADED-LAYOUT
 table: the mesh layout the compiler would re-plan onto after losing a
 shard (N-1 devices) and after losing half the mesh (N/2) — the same
@@ -121,6 +128,27 @@ def main(argv=None):
              "patch) against the full re-record (plan.plan_delta)",
     )
     ap.add_argument(
+        "--cache", action="store_true",
+        help="print the serve cache-fabric tier table instead: price a "
+             "per-replica L1 hit vs an L2 read of the one resident "
+             "stream vs a recompute, with the break-even L1 size "
+             "(plan.price_cache_tier)",
+    )
+    ap.add_argument(
+        "--replicas", type=int, default=3,
+        help="serve replica count for --cache (default 3)",
+    )
+    ap.add_argument(
+        "--l1-rows", type=int, default=None,
+        help="force the chosen per-replica L1 size for --cache "
+             "(default: the break-even size)",
+    )
+    ap.add_argument(
+        "--zipf-s", type=float, default=1.1,
+        help="zipf popularity exponent for the --cache hit model "
+             "(default 1.1, bench's BENCH_FLEET_ZIPF_S)",
+    )
+    ap.add_argument(
         "--json", action="store_true", dest="as_json",
         help="emit the plan's artifact block as JSON instead of the "
              "human report",
@@ -132,6 +160,7 @@ def main(argv=None):
         compile_plan,
         hbm_budget_bytes,
         plan_delta,
+        price_cache_tier,
         refit,
     )
 
@@ -160,6 +189,20 @@ def main(argv=None):
             print(json.dumps(dplan.as_dict(), indent=2))
         else:
             print(dplan.explain())
+        return 0
+    if args.cache:
+        try:
+            cplan = price_cache_tier(
+                inputs, coeffs=coeffs, replicas=args.replicas,
+                l1_rows=args.l1_rows, zipf_s=args.zipf_s,
+            )
+        except ValueError as exc:
+            print(exc.args[0], file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(cplan.as_dict(), indent=2))
+        else:
+            print(cplan.explain())
         return 0
     plan = compile_plan(
         inputs, coeffs=coeffs, mode=args.mode,

@@ -1,0 +1,12 @@
+"""Shared cache fabric: one recorded subgrid stream, N replica views.
+
+`SharedStreamTier` is the fleet-wide two-tier cache — a single
+versioned, spill-backed L2 over the recorded stream plus per-replica
+hot-row L1 views (`FabricFeedView`) with single-flight recompute dedup.
+See docs/serving.md (Cache fabric) and `plan.price_cache_tier` for the
+L1/L2/recompute pricing.
+"""
+
+from .fabric import FabricFeedView, SharedStreamTier
+
+__all__ = ["FabricFeedView", "SharedStreamTier"]

@@ -170,6 +170,17 @@ class IncrementalForward:
         to the CURRENT stream version."""
         return CachedColumnFeed(self.spill)
 
+    def fabric(self, *, l1_rows=64):
+        """A `cache.SharedStreamTier` over the recorded stream: ONE
+        resident, versioned L2 that N serve replicas front with hot-row
+        L1 views (`SharedStreamTier.view`). After `update`, roll the
+        fabric (`SharedStreamTier.roll` with the update report) instead
+        of re-building per-replica feeds — `serve.ServeFleet` does this
+        when constructed with ``fabric=``."""
+        from ..cache import SharedStreamTier
+
+        return SharedStreamTier(self.spill, l1_rows=l1_rows)
+
     # -- update -------------------------------------------------------------
 
     def update(self, new_facet_tasks, exact=None, use_plan=True):

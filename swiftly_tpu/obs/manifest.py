@@ -27,6 +27,7 @@ import time
 
 __all__ = [
     "BASELINE_SOURCES",
+    "CACHE_ARTIFACT_FIELDS",
     "DELTA_ARTIFACT_FIELDS",
     "FLEET_ARTIFACT_FIELDS",
     "MANIFEST_SCHEMA",
@@ -388,6 +389,86 @@ def validate_fleet_artifact(record):
             not isinstance(v, (int, float)) or v < 0
         ):
             problems.append(f"{field} {v!r} is not a latency")
+    problems.extend(_validate_cache_block(record, fleet))
+    return problems
+
+
+# The shared-cache-fabric block a fabric-backed `bench.py --fleet`
+# artifact carries (`cache.SharedStreamTier.stats` plus the QPS
+# equivalence audit) — the fabric's schema contract: exactly ONE
+# resident stream copy, a coherent hit/miss ledger, and per-view rows.
+CACHE_ARTIFACT_FIELDS = (
+    "resident_stream_copies",
+    "stream_version",
+    "views",
+    "l1_hits",
+    "l2_hits",
+    "misses",
+    "hit_ratio",
+    "dedup_hits",
+    "per_view",
+)
+
+
+def _validate_cache_block(record, fleet):
+    """Problems with a fleet artifact's ``cache`` (fabric) block. The
+    block is optional — pre-fabric fleet artifacts validate as before —
+    but when present it must show one resident stream copy and a
+    coherent hit ledger, and the fleet block must agree."""
+    cache = record.get("cache")
+    if cache is None:
+        return []
+    problems = []
+    if not isinstance(cache, dict):
+        return ["cache block is not a dict"]
+    for field in CACHE_ARTIFACT_FIELDS:
+        if field not in cache:
+            problems.append(f"cache block missing {field!r}")
+    copies = cache.get("resident_stream_copies")
+    if copies is not None and copies != 1:
+        problems.append(
+            f"resident_stream_copies is {copies!r}: the fabric's whole "
+            "contract is ONE resident stream across the fleet"
+        )
+    fleet_copies = fleet.get("stream_copies")
+    if fleet_copies is not None and copies == 1 and fleet_copies != 1:
+        problems.append(
+            f"fleet.stream_copies {fleet_copies!r} disagrees with the "
+            "cache block's one resident copy"
+        )
+    ratio = cache.get("hit_ratio")
+    if ratio is not None and (
+        not isinstance(ratio, (int, float)) or not 0.0 <= ratio <= 1.0
+    ):
+        problems.append(f"hit_ratio {ratio!r} is not in [0, 1]")
+    for field in ("l1_hits", "l2_hits", "misses", "dedup_hits"):
+        v = cache.get(field)
+        if v is not None and (not isinstance(v, int) or v < 0):
+            problems.append(f"cache {field} {v!r} is not a count")
+    served = (
+        cache.get("l1_hits", 0) + cache.get("l2_hits", 0)
+        + cache.get("misses", 0)
+    )
+    if isinstance(ratio, (int, float)) and served == 0 and ratio:
+        problems.append(
+            f"hit_ratio {ratio} with an empty hit/miss ledger"
+        )
+    per_view = cache.get("per_view")
+    if isinstance(per_view, list):
+        views = cache.get("views")
+        if isinstance(views, int) and len(per_view) != views:
+            problems.append(
+                f"per_view has {len(per_view)} row(s) for "
+                f"{views} view(s)"
+            )
+        for row in per_view:
+            if not isinstance(row, dict) or not (
+                {"replica", "l1_hits", "l2_hits"} <= set(row)
+            ):
+                problems.append(
+                    "per_view rows need {replica, l1_hits, l2_hits}"
+                )
+                break
     return problems
 
 

@@ -2171,7 +2171,7 @@ class CachedColumnFeed:
     .post_facet_update`) or fall back to compute.
     """
 
-    def __init__(self, spill):
+    def __init__(self, spill, *, index=None, stream_version=None):
         if not getattr(spill, "complete", False):
             raise ValueError(
                 "CachedColumnFeed requires a COMPLETE spill cache "
@@ -2179,16 +2179,33 @@ class CachedColumnFeed:
                 "incomplete stream would silently miss-serve"
             )
         self._spill = spill
-        self.stream_version = int(getattr(spill, "stream_version", 0))
-        self._index = {}  # (off0, off1, size) -> (k, c, s, recorded cfg)
-        for k in range(len(spill)):
-            for c, col in enumerate(spill.meta(k)):
-                for s, (_i, sg) in enumerate(col):
-                    self._index[(sg.off0, sg.off1, sg.size)] = (k, c, s, sg)
+        self.stream_version = int(
+            getattr(spill, "stream_version", 0)
+            if stream_version is None else stream_version
+        )
+        # views over one shared stream (`cache.SharedStreamTier`) pass
+        # a prebuilt index so N replicas don't re-scan the stream's
+        # metadata N times; plain feeds build their own
+        self._index = self.build_index(spill) if index is None else index
         self.hits = 0
         self.misses = 0
         self.evicted = 0
         self.stale = 0
+
+    @staticmethod
+    def build_index(spill):
+        """``(off0, off1, size) -> (k, c, s, recorded config)`` over a
+        complete recorded stream — the per-subgrid lookup table. Built
+        once per stream and shareable across feeds: patch-mode facet
+        updates rewrite entry PAYLOADS in place, so row coordinates
+        (and therefore this index) survive them; only a re-record
+        (replay) invalidates it."""
+        index = {}
+        for k in range(len(spill)):
+            for c, col in enumerate(spill.meta(k)):
+                for s, (_i, sg) in enumerate(col):
+                    index[(sg.off0, sg.off1, sg.size)] = (k, c, s, sg)
+        return index
 
     def __len__(self):
         return len(self._index)
@@ -2203,18 +2220,14 @@ class CachedColumnFeed:
         mb = np.ones(b.size) if b.mask1 is None else np.asarray(b.mask1)
         return np.array_equal(ma, mb)
 
-    def lookup(self, config):
-        """The recorded host row for ``config``, or None on a miss;
-        raises LookupError when the index hit an evicted entry or the
-        whole recorded stream was dropped (a ``reset`` cleared
-        ``complete`` — counted as an eviction), when the cache's
-        stream version moved since this feed was built (a facet
-        update patched the rows — this feed is stale), or when the
-        cache is mid-rewrite (``patching`` set by
-        `utils.spill.SpillCache.begin_patch`, which also brackets a
-        replay's reset-to-refill window) — a partially-patched stream
-        must never serve, even to a concurrent reader that races the
-        patcher."""
+    def _gate(self):
+        """The serve gate: raises LookupError unless the backing stream
+        is safe to read at this feed's pinned version (not mid-patch,
+        still complete, version unmoved). Factored out of `lookup` so
+        views that front this feed with a hot-row L1
+        (`cache.FabricFeedView`) can run the SAME gate before serving
+        an L1 row — an L1 hit must never outlive the version or bypass
+        a patch window."""
         if getattr(self._spill, "patching", False):
             self.stale += 1
             if _metrics.enabled():
@@ -2243,6 +2256,20 @@ class CachedColumnFeed:
                 f"({self.stream_version} -> {current}); this feed "
                 "indexes a superseded facet stack — rebuild it"
             )
+
+    def lookup(self, config):
+        """The recorded host row for ``config``, or None on a miss;
+        raises LookupError when the index hit an evicted entry or the
+        whole recorded stream was dropped (a ``reset`` cleared
+        ``complete`` — counted as an eviction), when the cache's
+        stream version moved since this feed was built (a facet
+        update patched the rows — this feed is stale), or when the
+        cache is mid-rewrite (``patching`` set by
+        `utils.spill.SpillCache.begin_patch`, which also brackets a
+        replay's reset-to-refill window) — a partially-patched stream
+        must never serve, even to a concurrent reader that races the
+        patcher."""
+        self._gate()
         hit = self._index.get((config.off0, config.off1, config.size))
         if hit is None or not self._masks_match(config, hit[3]):
             self.misses += 1

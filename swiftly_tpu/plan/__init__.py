@@ -31,6 +31,7 @@ from . import autotune, compiler, model
 from .autotune import load_history, refit
 from .compiler import (
     BackwardPlan,
+    CacheTierPlan,
     DeltaPlan,
     MeshLayout,
     Plan,
@@ -40,6 +41,7 @@ from .compiler import (
     plan_backward_passes,
     plan_delta,
     plan_mesh_layout,
+    price_cache_tier,
 )
 from .model import (
     CostCoefficients,
@@ -53,6 +55,7 @@ from .model import (
 
 __all__ = [
     "BackwardPlan",
+    "CacheTierPlan",
     "CostCoefficients",
     "DeltaPlan",
     "MeshLayout",
@@ -71,6 +74,7 @@ __all__ = [
     "plan_backward_passes",
     "plan_delta",
     "plan_mesh_layout",
+    "price_cache_tier",
     "projected_column_bytes",
     "projected_request_bytes",
 ]

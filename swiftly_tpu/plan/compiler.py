@@ -50,6 +50,7 @@ from .model import (
 
 __all__ = [
     "BackwardPlan",
+    "CacheTierPlan",
     "DeltaPlan",
     "MeshLayout",
     "Plan",
@@ -60,6 +61,7 @@ __all__ = [
     "plan_backward_passes",
     "plan_delta",
     "plan_mesh_layout",
+    "price_cache_tier",
 ]
 
 PLAN_SCHEMA = "swiftly-tpu-plan/1"
@@ -944,6 +946,206 @@ def plan_delta(inputs, changed_facets, coeffs=None, history=None):
         ),
         full_wall_s=full_wall,
         break_even_k=break_even,
+        alternatives=alternatives,
+        coeffs_source=coeffs.source,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cache-tier (serve fabric) planning
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CacheTierPlan:
+    """L1 / L2 / recompute pricing for the shared serve cache fabric.
+
+    For a replica fleet over one `cache.SharedStreamTier`, price where
+    each request lands: a per-replica hot-row **L1** hit (the
+    ``cache.l1`` rate), an **L2** read of the one resident stream (the
+    ``spill.read`` rate the spill cache serves at), or a **recompute**
+    (one coalesced column pass — what a stale bounce mid-patch falls
+    back to). The L1 hit share follows a zipf-over-subgrids popularity
+    model at ``zipf_s``; every scanned L1 size is kept in
+    ``alternatives`` (``chosen`` flags) so
+    ``scripts/plan_explain.py --cache`` prints the break-even table,
+    matching `compile_plan`'s alternative-recording contract.
+
+    ``break_even_l1_rows`` is the smallest per-replica L1 at which the
+    expected per-request wall sits within 1% of the best scanned size:
+    a bigger L1 buys latency the coefficients can no longer measure,
+    it only buys HBM.
+    """
+
+    replicas: int
+    n_subgrids: int
+    row_bytes: int
+    zipf_s: float
+    stale_rate: float
+    l1_hit_wall_s: float
+    l2_hit_wall_s: float
+    recompute_wall_s: float
+    l1_rows: int
+    break_even_l1_rows: int
+    expected_wall_s: float
+    alternatives: list = field(default_factory=list)
+    coeffs_source: str = "default"
+
+    def as_dict(self):
+        return {
+            "replicas": int(self.replicas),
+            "n_subgrids": int(self.n_subgrids),
+            "row_bytes": int(self.row_bytes),
+            "zipf_s": float(self.zipf_s),
+            "stale_rate": float(self.stale_rate),
+            "l1_hit_wall_s": round(float(self.l1_hit_wall_s), 9),
+            "l2_hit_wall_s": round(float(self.l2_hit_wall_s), 9),
+            "recompute_wall_s": round(float(self.recompute_wall_s), 6),
+            "l1_rows": int(self.l1_rows),
+            "break_even_l1_rows": int(self.break_even_l1_rows),
+            "expected_wall_s": round(float(self.expected_wall_s), 9),
+            "coeffs_source": self.coeffs_source,
+            "alternatives": list(self.alternatives),
+        }
+
+    def explain(self):
+        """Human-readable L1-size table
+        (``scripts/plan_explain.py --cache``)."""
+        lines = [
+            f"cache tier plan: {self.replicas} replica(s) over ONE "
+            f"resident stream of {self.n_subgrids} rows "
+            f"({self.coeffs_source} coefficients)",
+            f"  per request: L1 hit {self.l1_hit_wall_s * 1e6:.2f} us"
+            f" | L2 read {self.l2_hit_wall_s * 1e6:.2f} us"
+            f" | recompute {self.recompute_wall_s * 1e3:.3f} ms"
+            f" (one column pass; stale rate {self.stale_rate})",
+            f"  popularity: zipf_s={self.zipf_s} over "
+            f"{self.n_subgrids} subgrids; row_bytes={self.row_bytes}",
+            f"  break-even L1: {self.break_even_l1_rows} rows/replica "
+            "(larger L1s are within 1% of the best scanned wall)",
+            "  l1_rows  hit_l1  hit_l2  wall_per_req_us  "
+            "fleet_l1_bytes  choice",
+        ]
+        for alt in self.alternatives:
+            mark = " *" if alt.get("chosen") else ""
+            lines.append(
+                f"  {alt['l1_rows']:>7}  "
+                f"{alt['hit_l1']:>6.3f}  "
+                f"{alt['hit_l2']:>6.3f}  "
+                f"{alt['wall_per_req_s'] * 1e6:>15.2f}  "
+                f"{alt['fleet_l1_bytes']:>14d}"
+                f"{mark}"
+            )
+        return "\n".join(lines)
+
+
+def price_cache_tier(inputs, coeffs=None, history=None, *,
+                     replicas=3, l1_rows=None, zipf_s=1.1,
+                     stale_rate=0.02):
+    """Price the serve fabric's cache tiers for one config + replica
+    count; returns a `CacheTierPlan`.
+
+    The L2 (the one resident `utils.spill.SpillCache` recording) is
+    COMPLETE, so in steady state a request either hits a replica's L1,
+    reads the L2, or — at ``stale_rate``, the mid-patch / stale-bounce
+    fraction during facet updates — recomputes one coalesced column
+    pass. The L1 hit share for a per-replica capacity of ``c`` rows is
+    the zipf top-``c`` mass (rendezvous routing makes each replica's
+    popular set look like the global one over its column shard).
+    Candidate L1 sizes are scanned in powers of two up to the cover;
+    with ``l1_rows`` given, that size is the chosen row, otherwise the
+    break-even size is. Coefficients refit from artifact ``history``
+    exactly like `plan_delta` (autotune-refittable).
+    """
+    if coeffs is None:
+        if history:
+            from .autotune import refit
+
+            coeffs = refit(history)
+        else:
+            coeffs = CostCoefficients()
+    n_replicas = int(replicas)
+    if n_replicas < 1:
+        raise ValueError(f"replicas must be >= 1 (got {replicas})")
+    if not 0.0 <= float(stale_rate) < 1.0:
+        raise ValueError(
+            f"stale_rate must be in [0, 1) (got {stale_rate})"
+        )
+    n_rows = int(inputs.n_subgrids)
+    row_bytes = inputs.xA * inputs.xA * inputs.per_el
+    l1_wall = coeffs.price("cache.l1", bytes_moved=row_bytes).wall_s
+    l2_wall = coeffs.price("spill.read", bytes_moved=row_bytes).wall_s
+    # a miss recomputes ONE coalesced column pass (the serve scheduler's
+    # unit of compute); amortizing over co-batched requests is the
+    # scheduler's bonus, not the plan's promise
+    recompute_wall = (
+        sum(s.wall_s for s in price_forward(inputs, coeffs))
+        / max(1, inputs.n_columns)
+    )
+
+    # zipf top-c mass: H_c(s) / H_n(s)
+    weights = [1.0 / (i ** float(zipf_s)) for i in range(1, n_rows + 1)]
+    total_mass = sum(weights)
+    prefix = []
+    acc = 0.0
+    for w in weights:
+        acc += w
+        prefix.append(acc)
+
+    def expected(c):
+        hit_l1 = 0.0 if c <= 0 else prefix[min(c, n_rows) - 1] / total_mass
+        hit_l1 *= 1.0 - stale_rate
+        hit_l2 = 1.0 - stale_rate - hit_l1
+        wall = (
+            hit_l1 * l1_wall
+            + hit_l2 * l2_wall
+            + stale_rate * recompute_wall
+        )
+        return hit_l1, hit_l2, wall
+
+    candidates = [0]
+    c = 1
+    while c < n_rows:
+        candidates.append(c)
+        c *= 2
+    candidates.append(n_rows)
+    if l1_rows is not None and int(l1_rows) not in candidates:
+        candidates = sorted(set(candidates) | {int(l1_rows)})
+
+    priced = [(cc, *expected(cc)) for cc in candidates]
+    best_wall = min(p[3] for p in priced)
+    break_even = next(
+        cc for cc, _h1, _h2, wall in priced
+        if wall <= best_wall * 1.01
+    )
+    chosen_rows = break_even if l1_rows is None else int(l1_rows)
+    alternatives = []
+    chosen_wall = best_wall
+    for cc, h1, h2, wall in priced:
+        if cc == chosen_rows:
+            chosen_wall = wall
+        alternatives.append(
+            {
+                "l1_rows": cc,
+                "hit_l1": round(h1, 4),
+                "hit_l2": round(h2, 4),
+                "wall_per_req_s": round(wall, 9),
+                "fleet_l1_bytes": int(cc * row_bytes * n_replicas),
+                "chosen": cc == chosen_rows,
+            }
+        )
+    return CacheTierPlan(
+        replicas=n_replicas,
+        n_subgrids=n_rows,
+        row_bytes=int(row_bytes),
+        zipf_s=float(zipf_s),
+        stale_rate=float(stale_rate),
+        l1_hit_wall_s=l1_wall,
+        l2_hit_wall_s=l2_wall,
+        recompute_wall_s=recompute_wall,
+        l1_rows=chosen_rows,
+        break_even_l1_rows=break_even,
+        expected_wall_s=chosen_wall,
         alternatives=alternatives,
         coeffs_source=coeffs.source,
     )

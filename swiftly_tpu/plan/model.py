@@ -361,6 +361,11 @@ _DEFAULT_BYTES_PER_S = {
     "spill.h2d": 6e9,
     "spill.write": 3e9,
     "spill.read": 6e9,
+    # hot-row L1 hits in the serve cache fabric: an in-process dict
+    # probe plus one row memcpy-equivalent — far above the spill L2's
+    # read path, which a miss falls through to (`plan.price_cache_tier`
+    # ranks L1 size against it)
+    "cache.l1": 20e9,
     # the feed-once/fold-many stage: wall BLOCKED on the shared feed
     # (cache read + h2d dispatch, after the async prefetch and the fold
     # overlap hide what they can) per cache-fed byte. Defaults to the

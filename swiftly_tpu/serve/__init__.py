@@ -25,7 +25,12 @@ arXiv:2002.03260 applied to ragged demand):
   rendezvous-hashed column router with per-replica circuit breakers
   (`resilience.breaker`), zero-loss failover, journey-driven brownout
   and hedged sends — the self-healing serve fleet ``bench.py --fleet``
-  drills.
+  drills. Attach a `cache.SharedStreamTier` and the replicas serve
+  per-replica L1 views over ONE resident recorded stream;
+* `serve.autoscale.FleetAutoscaler` — queue-share-driven elastic
+  replica count over a ``[min, max]`` band with hysteresis: scale out
+  via `ServeFleet.add_replica` (a fabric view, not a stream copy) and
+  scale in through the zero-loss drain path.
 
 Entry points: build a `SwiftlyForward`, wrap it in a `SubgridService`,
 then ``submit(config).wait()`` (worker-thread mode via ``start()``) or
@@ -34,6 +39,7 @@ replays a zipf-over-columns workload through this stack and stamps the
 SLO block into its artifact. See docs/serving.md.
 """
 
+from .autoscale import FleetAutoscaler
 from .fleet import FleetRequest, Replica, ServeFleet
 from .health import (
     LIVE,
@@ -61,6 +67,7 @@ from .service import (
 __all__ = [
     "AdmissionQueue",
     "CoalescingScheduler",
+    "FleetAutoscaler",
     "FleetRequest",
     "HealthLease",
     "HealthMonitor",

@@ -310,6 +310,17 @@ class ServeFleet:
     :param request_bytes / column_bytes: the admission cost model
         (typically ``plan.serve.request_bytes`` /
         ``plan.serve.column_bytes``)
+    :param fabric: optional `cache.SharedStreamTier` — the shared cache
+        fabric. When set, the replica factory is called as
+        ``fn(rid, feed_view)`` and must build its service over that
+        view (ONE resident stream copy for the whole fleet; a factory
+        that builds its own per-replica cache defeats the fabric), and
+        `post_facet_update` rolls the fabric once instead of building N
+        feeds.
+    :param drain_timeout_s: grace a draining replica (autoscale
+        scale-in) gets to finish its backlog before the fleet
+        force-revokes its lease and fails the remainder over — the
+        zero-loss escape hatch, not the normal path
     """
 
     def __init__(self, replica_factory, n_replicas=3, *,
@@ -322,7 +333,8 @@ class ServeFleet:
                  failover_backoff_s=0.01, failover_backoff_max_s=0.5,
                  supervise_interval_s=0.002, poll_s=0.001, seed=0,
                  clock=time.monotonic, hbm_budget_bytes=None,
-                 request_bytes=0, column_bytes=0):
+                 request_bytes=0, column_bytes=0, fabric=None,
+                 drain_timeout_s=30.0):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self._clock = clock
@@ -339,35 +351,41 @@ class ServeFleet:
         self.failover_backoff_s = float(failover_backoff_s)
         self.failover_backoff_max_s = float(failover_backoff_max_s)
         self.supervise_interval_s = float(supervise_interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.fabric = fabric
+        # the autoscaler (serve.autoscale.FleetAutoscaler) attaches
+        # here; the supervisor tick evaluates it when present
+        self.autoscaler = None
+        # replica construction state, kept so `add_replica` can scale
+        # out after __init__ with the same factory and tuning
+        self._replica_factory = replica_factory
+        self._lease_kw = dict(
+            interval_s=lease_interval_s, miss_suspect=miss_suspect,
+            miss_revoke=miss_revoke,
+        )
+        self._breaker_kw = dict(
+            failure_threshold=breaker_threshold,
+            reopen_s=breaker_reopen_s,
+            max_reopen_s=breaker_max_reopen_s,
+            half_open_probes=half_open_probes,
+        )
+        self._seed = int(seed)
+        self._poll_s = float(poll_s)
         self.monitor = HealthMonitor(probe=self._probe, clock=clock)
-        self._replicas = {}
-        for rid in range(int(n_replicas)):
-            service = replica_factory(rid)
-            lease = HealthLease(
-                owner=f"replica-{rid}", interval_s=lease_interval_s,
-                miss_suspect=miss_suspect, miss_revoke=miss_revoke,
-                clock=clock,
-            )
-            breaker = CircuitBreaker(
-                name=f"replica-{rid}",
-                failure_threshold=breaker_threshold,
-                reopen_s=breaker_reopen_s,
-                max_reopen_s=breaker_max_reopen_s,
-                half_open_probes=half_open_probes,
-                rng=random.Random(seed + rid + 1),
-                clock=clock,
-            )
-            self.monitor.register(rid, lease)
-            self._replicas[rid] = Replica(
-                rid, service, lease, breaker, poll_s=poll_s
-            )
         self._lock = threading.RLock()
+        self._replicas = {}
+        self._draining = {}  # rid -> drain start time
+        self._retired = []   # final stats rows of drained replicas
+        self._next_rid = 0
+        for _ in range(int(n_replicas)):
+            self._build_replica()
         self._pending = {}  # freq.req_id -> _Entry
         self._counts = {
             "requests": 0, "served": 0, "shed": 0, "expired": 0,
             "quarantined": 0, "failovers": 0, "reroutes": 0,
             "hedges": 0, "hedge_wins": 0, "route_faults": 0,
             "brownout_sheds": 0, "hbm_sheds": 0, "restores": 0,
+            "scale_outs": 0, "drains": 0,
         }
         self._lat = []
         self._lat_i = 0
@@ -382,31 +400,69 @@ class ServeFleet:
 
     # -- topology ------------------------------------------------------------
 
+    def _build_replica(self):
+        """Construct and register one replica (service via the stored
+        factory — with a fabric, over its feed view — plus lease and
+        breaker); returns it. Does NOT start the pump."""
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            if self.fabric is not None:
+                service = self._replica_factory(
+                    rid, self.fabric.view(rid)
+                )
+            else:
+                service = self._replica_factory(rid)
+            lease = HealthLease(
+                owner=f"replica-{rid}", clock=self._clock,
+                **self._lease_kw,
+            )
+            breaker = CircuitBreaker(
+                name=f"replica-{rid}",
+                rng=random.Random(self._seed + rid + 1),
+                clock=self._clock, **self._breaker_kw,
+            )
+            self.monitor.register(rid, lease)
+            replica = Replica(
+                rid, service, lease, breaker, poll_s=self._poll_s
+            )
+            self._replicas[rid] = replica
+            return replica
+
     @property
     def replicas(self):
         return dict(self._replicas)
+
+    @property
+    def draining(self):
+        """rids currently draining toward retirement (scale-in)."""
+        with self._lock:
+            return set(self._draining)
 
     def replica(self, rid):
         return self._replicas[rid]
 
     def _probe(self, rid):
-        return self._replicas[rid].alive()
+        replica = self._replicas.get(rid)
+        return replica is not None and replica.alive()
 
     def preferred_replica(self, off0):
         """The rendezvous winner for a column over the FULL fleet
         (health-blind — the router's starting point; drills use it to
         aim traffic at a specific replica)."""
         return max(
-            self._replicas,
+            list(self._replicas),
             key=lambda rid: _rendezvous_score(off0, rid),
         )
 
     # -- routing -------------------------------------------------------------
 
     def _routable(self, rid, exclude):
-        if rid in exclude:
+        if rid in exclude or rid in self._draining:
             return False
-        replica = self._replicas[rid]
+        replica = self._replicas.get(rid)
+        if replica is None:
+            return False
         return not replica.dead and not replica.lease.revoked
 
     def _pick(self, off0, exclude, now):
@@ -426,13 +482,14 @@ class ServeFleet:
             _metrics.count("fleet.route_exhausted")
             return None
         order = sorted(
-            (rid for rid in self._replicas
+            (rid for rid in list(self._replicas)
              if self._routable(rid, exclude)),
             key=lambda rid: _rendezvous_score(off0, rid),
             reverse=True,
         )
         for rid in order:
-            if self._replicas[rid].breaker.allow(now):
+            replica = self._replicas.get(rid)
+            if replica is not None and replica.breaker.allow(now):
                 return rid
         return None
 
@@ -512,11 +569,14 @@ class ServeFleet:
             if rid is None:
                 break
             tried.add(rid)
+            replica = self._replicas.get(rid)
+            if replica is None:  # retired between pick and send
+                continue
             deadline_s = (
                 None if freq.deadline_t is None
                 else max(0.0, freq.deadline_t - self._clock())
             )
-            sub = self._replicas[rid].service.submit(
+            sub = replica.service.submit(
                 freq.config, priority=freq.priority,
                 deadline_s=deadline_s,
             )
@@ -578,11 +638,20 @@ class ServeFleet:
         for entry in entries:
             self._scan_entry(entry, now)
         self._update_brownout(now)
+        self._finalize_drains(now)
+        if self.autoscaler is not None:
+            try:
+                self.autoscaler.tick(now)
+            except Exception:  # noqa: BLE001 - policy must not kill ticks
+                _metrics.count("fleet.autoscaler_errors")
+                log.exception("autoscaler tick failed")
 
     def _on_revoked(self, rid, now):
         """A replica's lease was revoked: trip its breaker and strand
         its queue (the ledger scan re-routes every abandoned request)."""
-        replica = self._replicas[rid]
+        replica = self._replicas.get(rid)
+        if replica is None:  # retired while the transition was in flight
+            return
         replica.breaker.trip(now, reason="health lease revoked")
         stranded = replica.service.queue.drain()
         _metrics.count("fleet.revocations")
@@ -627,14 +696,16 @@ class ServeFleet:
                 # expired / quarantined: terminal, surface truthfully
                 self._finish(entry, res, rid, is_hedge, now)
                 return
-            replica = self._replicas[rid]
-            if replica.dead or replica.lease.revoked:
-                # in-flight on a dead replica: abandoned — failover
+            replica = self._replicas.get(rid)
+            if replica is None or replica.dead or replica.lease.revoked:
+                # in-flight on a dead (or retired) replica: abandoned —
+                # failover
                 self._counts["failovers"] += 1
                 _metrics.count("fleet.failover")
-                replica.breaker.record_failure(
-                    now, reason="request abandoned by dead replica"
-                )
+                if replica is not None:
+                    replica.breaker.record_failure(
+                        now, reason="request abandoned by dead replica"
+                    )
                 _trace.instant("fleet.failover", cat="fleet",
                                request_id=freq.req_id, replica=rid)
                 needs_reroute = True
@@ -659,7 +730,9 @@ class ServeFleet:
             self._counts["served"] += 1
             _metrics.count("fleet.served")
             if rid is not None:
-                self._replicas[rid].breaker.record_success(now)
+                winner = self._replicas.get(rid)
+                if winner is not None:
+                    winner.breaker.record_success(now)
             if is_hedge:
                 self._counts["hedge_wins"] += 1
                 _metrics.count("fleet.hedge_wins")
@@ -711,11 +784,14 @@ class ServeFleet:
         rid = self._pick(entry.freq.config.off0, {rid0}, now)
         if rid is None:
             return
+        replica = self._replicas.get(rid)
+        if replica is None:  # retired between pick and send
+            return
         deadline_s = (
             None if entry.freq.deadline_t is None
             else max(0.0, entry.freq.deadline_t - self._clock())
         )
-        sub = self._replicas[rid].service.submit(
+        sub = replica.service.submit(
             entry.freq.config, priority=entry.freq.priority,
             deadline_s=deadline_s,
         )
@@ -736,7 +812,7 @@ class ServeFleet:
         PR-5 journey decomposition aggregated over replicas) — the
         brownout trigger signal."""
         total_q = total = 0.0
-        for replica in self._replicas.values():
+        for replica in list(self._replicas.values()):
             q, t = replica.service.recent_journey_totals(window)
             total_q += q
             total += t
@@ -744,7 +820,8 @@ class ServeFleet:
 
     def queued_depth(self):
         return sum(
-            len(r.service.queue) for r in self._replicas.values()
+            len(r.service.queue)
+            for r in list(self._replicas.values())
         )
 
     def projected_fleet_bytes(self, off0=None):
@@ -755,7 +832,7 @@ class ServeFleet:
         request for that column (the admission probe)."""
         total = 0
         extra_col = off0 is not None
-        for replica in self._replicas.values():
+        for replica in list(self._replicas.values()):
             if replica.dead or replica.lease.revoked:
                 continue
             cols = replica.service.queue.columns()
@@ -772,7 +849,7 @@ class ServeFleet:
     def _brownout_retry_hint(self):
         hints = [
             r.service.queue.retry_after_hint()
-            for r in self._replicas.values()
+            for r in list(self._replicas.values())
         ]
         return min(hints) if hints else 0.05
 
@@ -798,7 +875,7 @@ class ServeFleet:
             # rung 2: per-request dispatch — coalesced batches stop
             # head-of-line-blocking the high-priority traffic that
             # survived rung 1's shed
-            for rid, replica in self._replicas.items():
+            for rid, replica in list(self._replicas.items()):
                 self._saved_max_batch[rid] = (
                     replica.service.scheduler.max_batch
                 )
@@ -839,7 +916,7 @@ class ServeFleet:
 
     def start(self):
         """Start every replica pump plus the supervisor thread."""
-        for replica in self._replicas.values():
+        for replica in list(self._replicas.values()):
             replica.start()
         self._sup_stop = False
         trace_ctx = _trace.current()
@@ -892,15 +969,29 @@ class ServeFleet:
         is no fleet-wide stop-the-world and no cache flush.
         """
         report = engine.update(new_facet_tasks, **update_kw)
-        for replica in self._replicas.values():
-            # a fresh feed per replica: feeds carry per-feed stale/hit
-            # state and the captured version, so replicas must not
-            # share one object — and each replica adopts the new stack
-            # into ITS OWN forward (forwards are per-pump-thread state)
-            replica.service.post_facet_update(
-                report=report, feed=engine.feed(),
-                new_facet_tasks=engine.facet_tasks,
-            )
+        if self.fabric is not None:
+            # ONE fabric roll: the shared L2 adopts the engine's new
+            # stream version (index rebuilt only on replay), every
+            # replica view is re-pointed in place and its hot-row L1
+            # cleared iff the version moved — no per-replica re-record
+            # and still exactly one resident stream copy
+            self.fabric.roll(report)
+            for rid, replica in sorted(self.replicas.items()):
+                replica.service.post_facet_update(
+                    report=report, feed=self.fabric.view(rid),
+                    new_facet_tasks=engine.facet_tasks,
+                )
+        else:
+            for replica in list(self._replicas.values()):
+                # a fresh feed per replica: feeds carry per-feed
+                # stale/hit state and the captured version, so replicas
+                # must not share one object — and each replica adopts
+                # the new stack into ITS OWN forward (forwards are
+                # per-pump-thread state)
+                replica.service.post_facet_update(
+                    report=report, feed=engine.feed(),
+                    new_facet_tasks=engine.facet_tasks,
+                )
         self._counts["facet_updates"] = (
             self._counts.get("facet_updates", 0) + 1
         )
@@ -911,6 +1002,132 @@ class ServeFleet:
             mode=report.get("mode"),
         )
         return report
+
+    # -- elasticity ----------------------------------------------------------
+
+    def add_replica(self):
+        """Scale out: one more replica from the stored factory and
+        tuning, pump started iff the fleet is running. With a cache
+        fabric attached the newcomer's service is built over a feed
+        VIEW of the one resident stream — scale-out costs an L1, never
+        a stream copy. Returns the new rid."""
+        replica = self._build_replica()
+        if self._sup_thread is not None:
+            replica.start()
+        self._counts["scale_outs"] += 1
+        _metrics.count("fleet.scale_outs")
+        _trace.instant("fleet.scale_out", cat="fleet",
+                       replica=replica.rid)
+        log.info("scale-out: replica %d joins (%d replicas)",
+                 replica.rid, len(self._replicas))
+        return replica.rid
+
+    def begin_drain(self, rid):
+        """Initiate zero-loss scale-in for one replica: routing stops
+        immediately (`_routable`), its queued and in-flight work
+        completes (or fails over), and a later supervision pass retires
+        the pump (`_finalize_drains`). Non-blocking and idempotent —
+        the autoscaler calls this from inside the supervisor tick."""
+        with self._lock:
+            if rid not in self._replicas:
+                raise KeyError(f"no replica {rid}")
+            if rid in self._draining:
+                return
+            self._draining[rid] = self._clock()
+        _metrics.count("fleet.drains_begun")
+        _trace.instant("fleet.drain_begin", cat="fleet", replica=rid)
+        log.info("drain: replica %d stops taking traffic", rid)
+
+    def _inflight_on(self, rid):
+        """Pending fleet requests with a live sub on this replica — a
+        racy snapshot; the drain path re-checks every pass."""
+        with self._lock:
+            entries = list(self._pending.values())
+        return sum(
+            1
+            for entry in entries
+            for sub_rid, _sub, _hedge in list(entry.subs)
+            if sub_rid == rid
+        )
+
+    def _finalize_drains(self, now):
+        """Retire draining replicas whose work is gone; force the
+        failover path on laggards past ``drain_timeout_s`` so scale-in
+        can never wedge the fleet (the requests still complete
+        elsewhere — zero loss, slower)."""
+        with self._lock:
+            items = list(self._draining.items())
+        for rid, since in items:
+            replica = self._replicas.get(rid)
+            if replica is None:
+                with self._lock:
+                    self._draining.pop(rid, None)
+                continue
+            if replica.dead or replica.lease.revoked:
+                # the health path already failed its work over
+                self._retire(rid, reason="dead_during_drain")
+                continue
+            if (
+                len(replica.service.queue) == 0
+                and self._inflight_on(rid) == 0
+            ):
+                self._retire(rid, reason="drained")
+                continue
+            if now - since > self.drain_timeout_s:
+                log.warning(
+                    "drain of replica %d exceeded %.1fs; forcing "
+                    "failover", rid, self.drain_timeout_s,
+                )
+                _metrics.count("fleet.drains_forced")
+                # revoke the lease: the monitor's next pass strands the
+                # queue and the ledger scan re-routes every sub
+                replica.lease.revoke()
+
+    def _retire(self, rid, reason="drained"):
+        """Remove one replica from the fleet: pump stopped, lease
+        unregistered (so its silence can't fire a phantom failover),
+        fabric view dropped, final serving counters kept in the
+        retired ledger."""
+        with self._lock:
+            replica = self._replicas.pop(rid, None)
+            self._draining.pop(rid, None)
+        if replica is None:
+            return
+        replica.stop(timeout=2.0)
+        self.monitor.unregister(rid)
+        if self.fabric is not None:
+            self.fabric.drop_view(rid)
+        s = replica.service.stats()
+        self._retired.append({
+            "id": rid, "reason": reason,
+            "served": s["n_served"], "requests": s["n_requests"],
+            "shed": s["n_shed"],
+        })
+        self._counts["drains"] += 1
+        _metrics.count("fleet.drains")
+        _trace.instant("fleet.replica_retired", cat="fleet",
+                       replica=rid, reason=reason)
+        log.info("drain: replica %d retired (%s; %d replicas left)",
+                 rid, reason, len(self._replicas))
+
+    def drain_replica(self, rid, timeout=None):
+        """Blocking convenience over `begin_drain`: returns True once
+        the replica is retired, False on timeout. Drives supervision
+        itself when no supervisor thread is running."""
+        self.begin_drain(rid)
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            with self._lock:
+                if rid not in self._replicas:
+                    return True
+            if self._sup_thread is None:
+                self.tick()
+            if deadline is not None and time.monotonic() > deadline:
+                with self._lock:
+                    return rid not in self._replicas
+            time.sleep(0.002)
 
     def kill_replica(self, rid):
         """Drill hook: simulated chip death for one replica."""
@@ -936,7 +1153,7 @@ class ServeFleet:
         if self._sup_thread is not None:
             self._sup_thread.join(timeout)
             self._sup_thread = None
-        for replica in self._replicas.values():
+        for replica in list(self._replicas.values()):
             replica.stop(timeout)
 
     # -- export --------------------------------------------------------------
@@ -954,7 +1171,7 @@ class ServeFleet:
             return lat[min(len(lat) - 1, int(p * len(lat)))]
 
         per_replica = []
-        for rid, replica in sorted(self._replicas.items()):
+        for rid, replica in sorted(self.replicas.items()):
             s = replica.service.stats()
             row = {
                 "id": rid,
@@ -972,8 +1189,18 @@ class ServeFleet:
             per_replica.append(row)
         with self._lock:
             pending = len(self._pending)
-        return {
+            draining = sorted(self._draining)
+            retired = list(self._retired)
+        out = {
             "n_replicas": len(self._replicas),
+            # with a fabric every replica serves a VIEW over the one
+            # recorded stream; without one, each factory-built service
+            # owns whatever feed it was given
+            "stream_copies": (
+                1 if self.fabric is not None else len(self._replicas)
+            ),
+            "draining": draining,
+            "retired": retired,
             **{k: v for k, v in self._counts.items()},
             "pending": pending,
             "p50_ms": round(q(0.50) * 1e3, 3),
@@ -993,8 +1220,11 @@ class ServeFleet:
             },
             "breakers": {
                 str(rid): r.breaker.stats()
-                for rid, r in sorted(self._replicas.items())
+                for rid, r in sorted(self.replicas.items())
             },
             "health": self.monitor.stats(),
             "per_replica": per_replica,
         }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.stats()
+        return out

@@ -159,6 +159,14 @@ class HealthMonitor:
     def lease(self, key):
         return self._leases.get(key)
 
+    def unregister(self, key):
+        """Retire a lease (the autoscaler's drain path): the monitor
+        stops grading it. Without this, a drained replica's idle lease
+        would decay to revoked and fire a phantom failover."""
+        with self._lock:
+            self._leases.pop(key, None)
+            self._last_state.pop(key, None)
+
     def _record(self, now, key, frm, to, via):
         if len(self.transitions) < _MAX_TRANSITIONS:
             self.transitions.append(

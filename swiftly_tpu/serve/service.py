@@ -355,14 +355,40 @@ class SubgridService:
             )
         return remaining
 
+    def _dedup_key(self, requests):
+        """Single-flight identity of one coalesced dispatch, or None
+        when dedup does not apply (no fabric-backed feed, or a fused
+        multi-column batch). The key is the exact request multiset —
+        offsets, sizes AND mask content at the admitted stream version —
+        so only genuinely identical concurrent batches collapse;
+        near-miss batches (a hedge's singleton vs the primary's
+        coalesced batch) stay independent dispatches."""
+        fabric = getattr(self.cache_feed, "fabric", None)
+        if fabric is None:
+            return None
+        if len({r.config.off0 for r in requests}) != 1:
+            return None
+        return (
+            "batch", self.stream_version,
+            tuple(fabric.request_key(r.config) for r in requests),
+        )
+
     def _execute(self, requests, _split_depth=0):
         """One coalesced dispatch for the taken requests, with
         batch-failure isolation. A fused-batch OOM first steps down the
         degradation ladder — split the batch in half and dispatch each
-        half (smaller transients) — before per-request isolation."""
+        half (smaller transients) — before per-request isolation.
+
+        With a fabric-backed feed (`cache.SharedStreamTier` view),
+        identical concurrent dispatches across replicas collapse
+        through the fabric's single-flight registry: the first replica
+        in computes, the rest adopt its (bit-identical) rows. A
+        leader's failure never propagates to followers — they fall back
+        to computing independently inside `single_flight`."""
         self._counts["batches"] += 1
         _metrics.count("serve.batches")
-        try:
+
+        def dispatch():
             _fault_point("serve.dispatch")
             if self.fault_injector is not None:
                 self.fault_injector(requests, 0)
@@ -370,12 +396,20 @@ class SubgridService:
                 if self.fuse_columns > 1:
                     configs, rows = self.scheduler.plan_fused(requests)
                     flat = self.fwd.all_subgrids(configs)
-                    results = [flat[r] for r in rows]
-                else:
-                    configs, _n_pad = self.scheduler.plan_batch(requests)
-                    results = self.fwd.get_subgrid_tasks(configs)[
-                        : len(requests)
-                    ]
+                    return [flat[r] for r in rows]
+                configs, _n_pad = self.scheduler.plan_batch(requests)
+                return self.fwd.get_subgrid_tasks(configs)[
+                    : len(requests)
+                ]
+
+        try:
+            key = (
+                self._dedup_key(requests) if _split_depth == 0 else None
+            )
+            if key is not None:
+                results = self.cache_feed.single_flight(key, dispatch)
+            else:
+                results = dispatch()
         except Exception as exc:
             self._counts["batch_failures"] += 1
             _metrics.count("serve.batch_failures")

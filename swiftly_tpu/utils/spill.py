@@ -36,11 +36,14 @@ the streamed executor against ``obs.metrics``.
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import logging
 import os
 import shutil
 import tempfile
+import threading
+import time
 
 import numpy as np
 
@@ -50,9 +53,24 @@ from ..resilience import degrade as _degrade
 from ..resilience.faults import fault_point
 from ..resilience.retry import retry_transient
 
-__all__ = ["SpillCache", "spill_budget_bytes"]
+__all__ = ["SpillCache", "StreamMidPatch", "spill_budget_bytes"]
 
 logger = logging.getLogger(__name__)
+
+# begin_patch waits this long for in-flight readers to drain before
+# proceeding anyway (readers are single-row copies; a wait this long
+# means a reader thread died mid-read — blocking the patch forever
+# would wedge the whole update path behind a corpse)
+_PATCH_DRAIN_TIMEOUT_S = 5.0
+
+
+class StreamMidPatch(LookupError):
+    """A row read raced ``begin_patch``: the stream is mid-rewrite.
+
+    A LookupError subclass so serving-path consumers
+    (`parallel.streamed.CachedColumnFeed`, `serve.SubgridService`)
+    treat it exactly like a stale-feed bounce — fall back to compute,
+    retry once the patch window closes."""
 
 # chunk size for disk-backed writes: bounds the per-write dirty-page
 # burst while keeping the stream sequential (memmap-friendly)
@@ -123,6 +141,16 @@ class SpillCache:
         # feeds refuse lookups for the whole window, so a concurrent
         # reader can never observe a partially-patched stream
         self.patching = False
+        # concurrency: one lock guards entry/meta/counter mutation; the
+        # condition implements the reader–writer gate (`begin_patch`
+        # drains in-flight row reads before the rewrite starts, and new
+        # reads bounce with `StreamMidPatch` until `end_patch`). The
+        # patcher's own thread passes the gate — `patch_entry` reads the
+        # base entry inside the window it opened.
+        self._lock = threading.RLock()
+        self._readers = threading.Condition(self._lock)
+        self._active_readers = 0
+        self._patcher_tid = None
         self.counters = {
             "writes": 0,
             "evictions": 0,
@@ -131,6 +159,36 @@ class SpillCache:
             "fills": 0,
             "patches": 0,
         }
+
+    # -- concurrency --------------------------------------------------------
+
+    def _bump(self, name, n=1):
+        """Thread-safe counter increment (the fabric's concurrent
+        readers would otherwise lose updates to the plain ``+=``)."""
+        with self._lock:
+            self.counters[name] += n
+
+    @contextlib.contextmanager
+    def _read_gate(self):
+        """Row-read side of the reader–writer gate: registers the read
+        so `begin_patch` can drain it, and bounces reads that arrive
+        inside a patch window (`StreamMidPatch` — unless the reader IS
+        the patcher, which must read base entries mid-window)."""
+        me = threading.get_ident()
+        with self._readers:
+            if self.patching and me != self._patcher_tid:
+                raise StreamMidPatch(
+                    "stream is mid-patch (begin_patch/end_patch window); "
+                    "fall back to compute and retry after the update"
+                )
+            self._active_readers += 1
+        try:
+            yield
+        finally:
+            with self._readers:
+                self._active_readers -= 1
+                if self._active_readers == 0:
+                    self._readers.notify_all()
 
     # -- fill ---------------------------------------------------------------
 
@@ -141,10 +199,11 @@ class SpillCache:
         consumer can refuse a cache recorded for different inputs."""
         self._clear_entries()
         self._sweep_orphans()
-        self.complete = False
-        self.gave_up = False
-        self.tag = tag
-        self.counters["fills"] += 1
+        with self._lock:
+            self.complete = False
+            self.gave_up = False
+            self.tag = tag
+            self.counters["fills"] += 1
         _trace.instant("spill.begin_fill", cat="spill", tag=str(tag))
 
     def put(self, meta, array) -> bool:
@@ -155,10 +214,11 @@ class SpillCache:
         will leave the cache incomplete.
         """
         array = np.asarray(array)
-        self.counters["writes"] += 1
+        self._bump("writes")
         if self.ram_bytes + array.nbytes <= self.budget_bytes:
-            self._entries.append(("ram", array))
-            self.ram_bytes += array.nbytes
+            with self._lock:
+                self._entries.append(("ram", array))
+                self.ram_bytes += array.nbytes
         elif self.spill_dir is not None:
             try:
                 path = self._disk_write(len(self._entries), array)
@@ -179,21 +239,23 @@ class SpillCache:
                     f"{type(exc).__name__}: {exc}",
                 )
                 self.spill_dir = None
-                self.counters["evictions"] += 1
+                self._bump("evictions")
                 self.gave_up = True
                 _metrics.count("spill.evictions")
                 return False
-            self._entries.append(("disk", path))
-            self.disk_bytes += array.nbytes
+            with self._lock:
+                self._entries.append(("disk", path))
+                self.disk_bytes += array.nbytes
         else:
-            self.counters["evictions"] += 1
+            self._bump("evictions")
             self.gave_up = True
             _metrics.count("spill.evictions")
             _trace.instant("spill.evict", cat="spill",
                            entry=len(self._entries),
                            nbytes=int(array.nbytes))
             return False
-        self._meta.append(meta)
+        with self._lock:
+            self._meta.append(meta)
         return True
 
     def end_fill(self):
@@ -227,22 +289,23 @@ class SpillCache:
         Disk reads retry transient failures with backoff; a read that
         stays failed raises (the streamed consumer then falls back to
         forward replay — see `StreamedForward.stream_column_groups`)."""
-        kind, payload = self._entries[k]
+        with self._read_gate():
+            kind, payload = self._entries[k]
 
-        def read():
-            fault_point("spill.read")
-            if kind == "ram":
-                return payload
-            with _metrics.stage("spill.disk_read") as st:
-                arr = np.load(payload)
-                st.bytes_moved = int(arr.nbytes)
-            return arr
+            def read():
+                fault_point("spill.read")
+                if kind == "ram":
+                    return payload
+                with _metrics.stage("spill.disk_read") as st:
+                    arr = np.load(payload)
+                    st.bytes_moved = int(arr.nbytes)
+                return arr
 
-        out = retry_transient(read, site="spill.read")
+            out = retry_transient(read, site="spill.read")
         if kind == "ram":
-            self.counters["ram_reads"] += 1
+            self._bump("ram_reads")
         else:
-            self.counters["disk_reads"] += 1
+            self._bump("disk_reads")
             _metrics.count("spill.disk_reads")
         return out
 
@@ -254,24 +317,28 @@ class SpillCache:
         single subgrids out of recorded streams; RAM entries slice in
         place and disk entries go through a read-only memmap, so a
         one-subgrid request against a multi-GiB disk entry costs one
-        row's IO, not the entry's.
+        row's IO, not the entry's. Registers with the reader–writer
+        gate: a read that races `begin_patch` raises `StreamMidPatch`
+        (a LookupError — the serving path's fall-back-to-compute
+        signal), and the patch itself waits for in-flight reads.
         """
-        kind, payload = self._entries[k]
+        with self._read_gate():
+            kind, payload = self._entries[k]
 
-        def read():
-            fault_point("spill.get_row")
-            if kind == "ram":
-                return payload[index]
-            with _metrics.stage("spill.disk_read") as st:
-                row = np.array(np.load(payload, mmap_mode="r")[index])
-                st.bytes_moved = int(row.nbytes)
-            return row
+            def read():
+                fault_point("spill.get_row")
+                if kind == "ram":
+                    return payload[index]
+                with _metrics.stage("spill.disk_read") as st:
+                    row = np.array(np.load(payload, mmap_mode="r")[index])
+                    st.bytes_moved = int(row.nbytes)
+                return row
 
-        out = retry_transient(read, site="spill.get_row")
+            out = retry_transient(read, site="spill.get_row")
         if kind == "ram":
-            self.counters["ram_reads"] += 1
+            self._bump("ram_reads")
         else:
-            self.counters["disk_reads"] += 1
+            self._bump("disk_reads")
             _metrics.count("spill.disk_reads")
         return out
 
@@ -283,13 +350,36 @@ class SpillCache:
         observe a partially-patched stream — its consumers fall back to
         compute at their pinned version. The patcher clears the mark
         with `end_patch` AFTER re-stamping ``stream_version``, so there
-        is no window in which a superseded feed serves."""
-        self.patching = True
+        is no window in which a superseded feed serves.
+
+        Writer side of the reader–writer gate: after raising the mark
+        (which bounces NEW row reads with `StreamMidPatch`) it waits for
+        in-flight reads to drain, so the rewrite never races a reader
+        that passed the feed's gate check just before the mark went up.
+        """
+        deadline = time.monotonic() + _PATCH_DRAIN_TIMEOUT_S
+        with self._readers:
+            self.patching = True
+            self._patcher_tid = threading.get_ident()
+            while self._active_readers:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "begin_patch proceeding with %d reader(s) still "
+                        "in flight after %.1fs — a reader thread looks "
+                        "dead", self._active_readers,
+                        _PATCH_DRAIN_TIMEOUT_S,
+                    )
+                    break
+                self._readers.wait(timeout=remaining)
         _trace.instant("spill.begin_patch", cat="spill")
 
     def end_patch(self):
         """Clear the mid-patch mark (see `begin_patch`)."""
-        self.patching = False
+        with self._readers:
+            self.patching = False
+            self._patcher_tid = None
+            self._readers.notify_all()
         _trace.instant("spill.end_patch", cat="spill")
 
     def patch_entry(self, k, delta):
@@ -308,7 +398,8 @@ class SpillCache:
         retries raises; the caller's ladder degrades to a full
         re-record.
         """
-        kind, payload = self._entries[k]
+        with self._lock:
+            kind, payload = self._entries[k]
         delta = np.asarray(delta)
         base = self.get(k)
         if base.shape != delta.shape:
@@ -324,8 +415,10 @@ class SpillCache:
                 with _metrics.stage("spill.patch") as st:
                     # out of place: recomputed from the unmodified
                     # `payload` on every retry; the entry swap is one
-                    # reference assignment, atomic for concurrent reads
-                    self._entries[k] = ("ram", payload + add)
+                    # reference assignment under the lock, atomic for
+                    # concurrent reads (which hold old-array views)
+                    with self._lock:
+                        self._entries[k] = ("ram", payload + add)
                     st.bytes_moved = int(add.nbytes)
 
             retry_transient(write, site="spill.write")
@@ -333,7 +426,7 @@ class SpillCache:
             with _metrics.stage("spill.patch") as st:
                 self._disk_write(k, base + add)
                 st.bytes_moved = int(add.nbytes)
-        self.counters["patches"] += 1
+        self._bump("patches")
         _metrics.count("spill.patches")
         _trace.instant("spill.patch", cat="spill", entry=int(k),
                        nbytes=int(add.nbytes))
@@ -345,8 +438,9 @@ class SpillCache:
         swept); counters are kept."""
         self._clear_entries()
         self._sweep_orphans()
-        self.complete = False
-        self.gave_up = False
+        with self._lock:
+            self.complete = False
+            self.gave_up = False
 
     def stats(self):
         """JSON-ready summary for bench artifacts."""
@@ -365,13 +459,14 @@ class SpillCache:
         return out
 
     def _clear_entries(self):
-        self._entries = []
-        self._meta = []
-        self.ram_bytes = 0
-        self.disk_bytes = 0
-        if self._own_dir is not None:
-            shutil.rmtree(self._own_dir, ignore_errors=True)
-            self._own_dir = None
+        with self._lock:
+            self._entries = []
+            self._meta = []
+            self.ram_bytes = 0
+            self.disk_bytes = 0
+            own_dir, self._own_dir = self._own_dir, None
+        if own_dir is not None:
+            shutil.rmtree(own_dir, ignore_errors=True)
 
     def _sweep_orphans(self):
         """Remove ``.tmp`` siblings a crashed fill left behind — in this

@@ -243,16 +243,20 @@ def test_bench_serve_smoke_leg(tmp_path):
     ) == record["n_served"]
 
 
+@pytest.mark.slow
 def test_bench_fleet_smoke_leg(tmp_path):
-    """The `bench.py --fleet --smoke` leg: 3 SubgridService replicas
+    """The full `bench.py --fleet --smoke` drill: 3 SubgridService
+    replicas over the shared cache fabric (one resident stream copy)
     behind the rendezvous column router with health leases + circuit
-    breakers, one replica killed mid-zipf-workload and restored, run
-    exactly as the driver would (fresh subprocess, CPU) — zero lost
-    requests, results bit-identical to per-request compute, the
-    victim's breaker cycling open → half-open → closed, p99 recovering
-    to <= 1.5x the pre-kill window, route faults survived, and the
-    brownout ladder (shed-with-hint, per-request dispatch, recovery)
-    all validated via `obs.validate_fleet_artifact`."""
+    breakers, one replica killed mid-zipf-workload and restored, then
+    the sustained-zipf autoscale phase (scale out under load, drain
+    after) — zero lost requests, results bit-identical per serving
+    path, the victim's breaker cycling open → half-open → closed, p99
+    recovering to <= 1.5x the pre-kill window, route faults survived,
+    the brownout ladder, and the ``cache`` block all validated via
+    `obs.validate_fleet_artifact`. Slow-gated since the autoscale
+    phase landed (tier-1 keeps the in-process fleet/fabric tests in
+    tests/test_fleet.py and the synthetic sentinel trips below)."""
     out = tmp_path / "BENCH_fleet.json"
     env = dict(os.environ)
     env.update(
@@ -321,6 +325,23 @@ def test_bench_fleet_smoke_leg(tmp_path):
     assert counters["health.revoked"] >= 1
     assert record["manifest"]["device"]["platform"] == "cpu"
 
+    # the cache fabric: ONE resident stream copy for the whole fleet,
+    # replicas serving from L1/L2 views, no re-index during the drill
+    cache = record["cache"]
+    assert cache["resident_stream_copies"] == 1
+    assert fl["stream_copies"] == 1
+    assert cache["hit_ratio"] >= 0.5
+    assert cache["views"] >= 3
+    assert cache["index_builds"] == 1 and cache["rolls"] == 0
+    assert len(cache["per_view"]) == cache["views"]
+    assert record["bit_identical"]["cross_program_mismatches"] == 0
+    # the autoscale phase scaled out under load and drained back with
+    # zero loss, at >= 10x single-service QPS equivalent
+    auto = fl["autoscale"]
+    assert auto["scale_outs"] >= 1 and auto["drains"] >= 1
+    assert cache["qps_equivalent_ratio"] >= 10.0
+    assert any(r["reason"] == "drained" for r in fl["retired"])
+
     # --- the serving sentinel (in-process: no extra spawn) ------------
     sys.path.insert(0, str(REPO))
     from scripts.bench_compare import main as compare_main
@@ -340,6 +361,73 @@ def test_bench_fleet_smoke_leg(tmp_path):
     assert compare_main(
         [str(out), "--against", str(ref), "--json"]
     ) == 1
+    # doctored 2x-better cache hit ratio in the reference -> the
+    # fabric sentinel must trip (wall/p99/QPS left untouched)
+    doctored = json.loads(out.read_text())
+    doctored["cache"]["hit_ratio"] = cache["hit_ratio"] * 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 1
+    # a latest run that regressed to per-replica stream copies must
+    # trip against the clean one-copy reference (no threshold: ANY
+    # increase breaks the fabric's claim)
+    ref.write_text(json.dumps(record))
+    worse = tmp_path / "BENCH_fleet_copies.json"
+    regressed = json.loads(out.read_text())
+    regressed["fleet"]["stream_copies"] = 3
+    worse.write_text(json.dumps(regressed))
+    assert compare_main(
+        [str(worse), "--against", str(ref), "--json"]
+    ) == 1
+
+
+def test_compare_fabric_sentinels_synthetic(tmp_path):
+    """The `cache.hit_ratio` / `fleet.stream_copies` sentinels in
+    scripts/bench_compare.py, exercised in tier-1 on synthetic records
+    (the full fleet drill that stamps real ones is slow-gated):
+    identical records stay green, a decayed hit ratio trips at the
+    threshold, and ANY stream-copy increase over the reference trips
+    with no threshold arithmetic — while FEWER copies than the
+    reference is an improvement and stays green."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    def rec(hit_ratio=0.9, stream_copies=1):
+        return {
+            "metric": "fleet drill wall-clock",
+            "value": 2.0,
+            "manifest": {
+                "config_params": {
+                    "config": "1k[1]-n512-256", "mode": "fleet",
+                },
+                "device": {"platform": "cpu"},
+            },
+            "p99_ms": 10.0,
+            "throughput_rps": 500.0,
+            "cache": {"hit_ratio": hit_ratio},
+            "fleet": {"stream_copies": stream_copies},
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    latest.write_text(json.dumps(rec()))
+    ref.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # hit ratio decayed >20% below the best reference -> trip
+    latest.write_text(json.dumps(rec(hit_ratio=0.6)))
+    assert compare_main(args) == 1
+    # within the threshold -> green (it is a threshold, not equality)
+    latest.write_text(json.dumps(rec(hit_ratio=0.8)))
+    assert compare_main(args) == 0
+    # stream copies: ANY increase over the reference trips
+    latest.write_text(json.dumps(rec(stream_copies=2)))
+    assert compare_main(args) == 1
+    # ...and fewer copies than the reference stays green
+    latest.write_text(json.dumps(rec(stream_copies=1)))
+    ref.write_text(json.dumps(rec(stream_copies=3)))
+    assert compare_main(args) == 0
 
 
 def test_bench_mesh_smoke_leg(tmp_path):

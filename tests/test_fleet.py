@@ -37,6 +37,7 @@ from pathlib import Path
 import numpy as np
 import pytest
 
+from swiftly_tpu.cache import SharedStreamTier
 from swiftly_tpu.resilience.breaker import (
     CLOSED,
     HALF_OPEN,
@@ -45,6 +46,7 @@ from swiftly_tpu.resilience.breaker import (
 )
 from swiftly_tpu.resilience.retry import is_oom
 from swiftly_tpu.serve import service as serve_service
+from swiftly_tpu.serve.autoscale import FleetAutoscaler
 from swiftly_tpu.serve.fleet import ServeFleet
 from swiftly_tpu.serve.health import (
     LIVE,
@@ -60,6 +62,7 @@ from swiftly_tpu.serve.queue import (
     RequestResult,
     SubgridRequest,
 )
+from swiftly_tpu.utils.spill import SpillCache
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -232,6 +235,11 @@ def test_monitor_probe_failure_revokes_suspect_immediately():
 
 
 class _Cfg:
+    # mask-less by default: the cache-fabric feed's `_masks_match`
+    # reads these like a real SubgridConfig's
+    mask0 = None
+    mask1 = None
+
     def __init__(self, off0, off1=0, size=16):
         self.off0 = off0
         self.off1 = off1
@@ -528,6 +536,393 @@ def test_fleet_brownout_ladder_and_recovery():
 
 
 # ---------------------------------------------------------------------------
+# Cache fabric: one shared L2, per-replica L1 views, single-flight dedup
+# ---------------------------------------------------------------------------
+
+
+def _mini_fabric(n_cols=3, rows=4, l1_rows=64):
+    """A hand-filled recorded stream (n_cols entries x rows subgrids,
+    entry k's rows uniformly 100k + s) under a `SharedStreamTier`."""
+    spill = SpillCache(budget_bytes=1e9)
+    spill.begin_fill(tag="fabric-test")
+    cols = {}
+    for k in range(n_cols):
+        col = [_Cfg(16 * k, 8 * s) for s in range(rows)]
+        arr = np.stack(
+            [np.full((5,), 100.0 * k + s, np.float32)
+             for s in range(rows)]
+        )[None]
+        assert spill.put([list(enumerate(col))], arr)
+        cols[k] = col
+    assert spill.end_fill()
+    return SharedStreamTier(spill, l1_rows=l1_rows), spill, cols
+
+
+def test_fabric_views_share_one_l2_and_own_their_l1():
+    fabric, spill, cols = _mini_fabric()
+    v0 = fabric.view(0)
+    assert fabric.view(0) is v0  # stable per replica
+    v1 = fabric.view(1)
+    cfg = cols[0][0]
+    row = v0.lookup(cfg)  # L2 read + promotion into v0's L1
+    np.testing.assert_array_equal(row, np.full((5,), 0.0, np.float32))
+    assert v0.l2_hits == 1 and v0.l1_hits == 0 and v0.promotions == 1
+    np.testing.assert_array_equal(v0.lookup(cfg), row)  # L1 hit
+    assert v0.l1_hits == 1
+    # the other replica's L1 is its own: its first lookup hits the L2
+    assert v1.lookup(cfg) is not None and v1.l2_hits == 1
+    # L1 hits never touch the shared spill — exactly two L2 row reads
+    assert spill.stats()["ram_reads"] == 2
+    # a config outside the recorded cover is a miss, not an error
+    assert v0.lookup(_Cfg(999)) is None and v0.misses == 1
+    st = fabric.stats()
+    assert st["resident_stream_copies"] == 1
+    assert st["views"] == 2 and st["stream_entries"] == 3
+    assert st["l1_hits"] == 1 and st["l2_hits"] == 2
+    assert st["hit_ratio"] == 0.75  # 3 served / 4 lookups
+    assert {r["replica"] for r in st["per_view"]} == {0, 1}
+
+
+def test_fabric_l1_is_bounded_and_retired_views_keep_counters():
+    fabric, _spill, cols = _mini_fabric(n_cols=1, rows=4, l1_rows=2)
+    v = fabric.view(7)
+    for cfg in cols[0]:
+        v.lookup(cfg)
+    assert v.l1_evictions == 2 and v.stats()["l1_len"] == 2
+    # the two hottest (most recent) rows answer from L1
+    assert v.lookup(cols[0][-1]) is not None
+    assert v.l1_hits == 1
+    # a drained replica's view folds into the retired ledger so
+    # fabric-wide stats survive scale-in
+    fabric.drop_view(7)
+    st = fabric.stats()
+    assert st["views"] == 0 and st["retired_views"] == 1
+    assert st["l2_hits"] == 4 and st["l1_hits"] == 1
+    assert st["l1_evictions"] == 2
+
+
+def test_fabric_gate_mid_patch_version_pin_and_roll():
+    fabric, spill, cols = _mini_fabric()
+    v = fabric.view(0)
+    cfg = cols[0][0]
+    assert v.lookup(cfg) is not None  # now L1-resident
+    # mid-patch: even the L1-resident row refuses — an L1 hit must
+    # never bypass the patch window
+    spill.begin_patch()
+    try:
+        with pytest.raises(LookupError):
+            v.lookup(cfg)
+    finally:
+        spill.end_patch()
+    assert v.stale == 1
+    assert v.lookup(cfg) is not None  # serving resumes after end_patch
+    # version pin: a landed facet update re-stamps the spill; the view
+    # refuses at its old pin until the fabric rolls it forward
+    spill.stream_version += 1
+    with pytest.raises(LookupError):
+        v.lookup(cfg)
+    assert v.stale == 2
+    assert fabric.roll({"mode": "patch"}) == 1
+    assert fabric.stream_version == 1 and v.stream_version == 1
+    # patch mode rewrites payloads in place: row coordinates — and the
+    # shared index — survive, so no re-scan; but the L1 rows were
+    # recorded under the superseded stack and are dropped
+    assert fabric.index_builds == 1 and fabric.rolls == 1
+    assert v.stats()["l1_len"] == 0
+    assert v.lookup(cfg) is not None
+    # a replay re-recorded the stream: the index is rebuilt once and
+    # every live view re-points at it
+    v2 = fabric.view(1)
+    spill.stream_version += 1
+    fabric.roll({"mode": "replay"})
+    assert fabric.index_builds == 2
+    assert v._index is fabric.index and v2._index is fabric.index
+    assert v.stream_version == v2.stream_version == 2
+
+
+def test_fabric_single_flight_dedups_concurrent_misses():
+    import threading
+
+    fabric, _spill, _cols = _mini_fabric()
+    release = threading.Event()
+    calls, results = [], []
+
+    def slow_compute():
+        calls.append(threading.get_ident())
+        assert release.wait(timeout=10.0)
+        return "payload"
+
+    leader = threading.Thread(
+        target=lambda: results.append(
+            fabric.single_flight("col-9", slow_compute)
+        )
+    )
+    leader.start()
+    deadline = time.time() + 10.0
+    while "col-9" not in fabric._inflight and time.time() < deadline:
+        time.sleep(0.001)  # leadership is registered: followers dedup
+    followers = [
+        threading.Thread(
+            target=lambda: results.append(
+                fabric.single_flight("col-9", lambda: "follower")
+            )
+        )
+        for _ in range(3)
+    ]
+    for t in followers:
+        t.start()
+    time.sleep(0.02)
+    release.set()
+    for t in [leader, *followers]:
+        t.join(timeout=10.0)
+    # ONE compute; every caller got the leader's result
+    assert len(calls) == 1
+    assert results == ["payload"] * 4
+    assert fabric.dedup_computes == 1 and fabric.dedup_hits == 3
+
+
+def test_fabric_single_flight_leader_failure_does_not_fan_out():
+    import threading
+
+    fabric, _spill, _cols = _mini_fabric()
+    release = threading.Event()
+    errors, follower_out = [], []
+
+    def failing_leader():
+        def fail():
+            assert release.wait(timeout=10.0)
+            raise RuntimeError("leader died")
+
+        try:
+            fabric.single_flight("col-3", fail)
+        except RuntimeError as exc:
+            errors.append(exc)
+
+    t_lead = threading.Thread(target=failing_leader)
+    t_lead.start()
+    deadline = time.time() + 10.0
+    while "col-3" not in fabric._inflight and time.time() < deadline:
+        time.sleep(0.001)
+    t_follow = threading.Thread(
+        target=lambda: follower_out.append(
+            fabric.single_flight("col-3", lambda: "independent")
+        )
+    )
+    t_follow.start()
+    time.sleep(0.02)
+    release.set()
+    t_lead.join(timeout=10.0)
+    t_follow.join(timeout=10.0)
+    # the failure re-raised to the leader ONLY; the follower computed
+    # independently — dedup never converts one failure into N
+    assert len(errors) == 1
+    assert follower_out == ["independent"]
+
+
+def test_fabric_request_key_separates_masked_configs():
+    key = SharedStreamTier.request_key
+    assert key(_Cfg(0, 8)) == key(_Cfg(0, 8))
+    assert key(_Cfg(0, 8)) != key(_Cfg(0, 16))
+    masked = _Cfg(0, 8)
+    masked.mask0 = np.zeros(masked.size)
+    assert key(masked) != key(_Cfg(0, 8))  # masks are part of the result
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler policy (stub fleet): hysteresis, cooldown, band, drain pick
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_scale_out_needs_held_streak_then_cooldown_band():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2)
+    auto = FleetAutoscaler(
+        fleet, min_replicas=1, max_replicas=3, up_share=0.6,
+        down_share=0.15, min_queue_depth=2, hold_ticks=3,
+        cooldown_s=0.5, clock=clk,
+    )
+    fleet.autoscaler = auto
+    for r in fleet.replicas.values():
+        r.service.journeys = (9.0, 10.0)  # queue share 0.9
+    for i in range(4):
+        fleet.submit(_Cfg(i), priority=1)  # backlog >= depth floor
+    assert auto.tick(clk.t) is None  # streak 1
+    assert auto.tick(clk.t) is None  # streak 2
+    assert auto.tick(clk.t) == "scale_out"  # streak held -> act
+    assert len(fleet.replicas) == 3
+    assert auto.events[0]["action"] == "scale_out"
+    # cooldown holds the next decisions even under sustained pressure
+    assert auto.tick(clk.t) is None
+    assert auto.tick(clk.t) is None
+    assert auto.stats()["held_by_cooldown"] == 2
+    # past the cooldown the streak is held again — but the band caps
+    # the fleet at max_replicas
+    clk.t += 1.0
+    assert auto.tick(clk.t) is None
+    assert auto.stats()["held_by_band"] == 1
+    assert len(fleet.replicas) == 3
+    assert auto.stats()["scale_outs"] == 1
+
+
+def test_autoscaler_dead_zone_resets_streaks():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2)
+    auto = FleetAutoscaler(
+        fleet, min_replicas=1, max_replicas=4, up_share=0.6,
+        down_share=0.15, min_queue_depth=2, hold_ticks=2,
+        cooldown_s=0.0, clock=clk,
+    )
+    for r in fleet.replicas.values():
+        r.service.journeys = (9.0, 10.0)
+    fleet.submit(_Cfg(0), priority=1)
+    fleet.submit(_Cfg(1), priority=1)
+    assert auto.tick(clk.t) is None  # up streak 1
+    # the signal dips into the dead zone: BOTH streaks reset —
+    # hysteresis demands an unbroken one-sided signal
+    for r in fleet.replicas.values():
+        r.service.journeys = (4.0, 10.0)  # share 0.4
+    assert auto.tick(clk.t) is None
+    for r in fleet.replicas.values():
+        r.service.journeys = (9.0, 10.0)
+    assert auto.tick(clk.t) is None  # streak restarted at 1, not 2
+    assert auto.tick(clk.t) == "scale_out"
+    assert len(fleet.replicas) == 3
+
+
+def test_autoscaler_drains_idlest_replica_and_fleet_retires_it():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2)
+    auto = FleetAutoscaler(
+        fleet, min_replicas=1, max_replicas=4, up_share=0.6,
+        down_share=0.15, min_queue_depth=4, hold_ticks=2,
+        cooldown_s=0.0, clock=clk,
+    )
+    fleet.autoscaler = auto
+    # scale out first (hot signal + backlog)
+    for r in fleet.replicas.values():
+        r.service.journeys = (9.0, 10.0)
+    reqs = [fleet.submit(_Cfg(i), priority=1) for i in range(6)]
+    auto.tick(clk.t)
+    assert auto.tick(clk.t) == "scale_out"
+    newcomer = max(fleet.replicas)
+    fleet.replica(newcomer).lease.beat(clk.t)
+    # load fades: queues drain, the journey share drops to idle
+    for r in fleet.replicas.values():
+        r.service.pump()
+        r.service.journeys = (0.0, 10.0)
+    assert auto.tick(clk.t) is None  # down streak 1
+    assert auto.tick(clk.t) == "drain"
+    # the candidate is the idlest replica, ties to the HIGHEST rid —
+    # later scale-outs drain first, the core fleet keeps warm forwards
+    assert auto.events[-1]["replica"] == newcomer
+    assert newcomer in fleet.draining
+    # a second policy hit cannot double-pick the draining replica
+    assert auto._drain_candidate() != newcomer
+    # the supervision pass retires it (queue empty, nothing in flight)
+    _beat(fleet, clk)
+    fleet.tick(clk.t)
+    assert newcomer not in fleet.replicas
+    st = fleet.stats()
+    assert st["scale_outs"] == 1 and st["drains"] == 1
+    assert st["retired"][0]["id"] == newcomer
+    assert st["retired"][0]["reason"] == "drained"
+    assert st["autoscale"]["scale_outs"] == 1
+    assert st["autoscale"]["drains"] == 1
+    # park the signal in the dead zone so the remaining supervision
+    # ticks (fleet.tick drives the attached autoscaler too) hold still
+    for r in fleet.replicas.values():
+        r.service.journeys = (4.0, 10.0)
+    for fr in reqs:
+        fleet.tick(clk.t)
+        assert fr.done and fr.result.ok  # zero loss through the cycle
+
+
+# ---------------------------------------------------------------------------
+# Fleet elasticity: add_replica / begin_drain lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_add_replica_joins_routing():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, n=2)
+    rid = fleet.add_replica()
+    assert rid == 2 and len(fleet.replicas) == 3
+    fleet.replica(rid).lease.beat(clk.t)
+    # rendezvous hands the newcomer a share of columns, and submits
+    # to those columns admit there
+    off0 = next(
+        o for o in range(256) if fleet.preferred_replica(o) == rid
+    )
+    freq = fleet.submit(_Cfg(off0), priority=1)
+    assert freq.replica_trail[-1] == rid
+    fleet.replica(rid).service.pump()
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.data[0] == rid
+    assert fleet.stats()["scale_outs"] == 1
+
+
+def test_fleet_begin_drain_stops_routing_and_retires_zero_loss():
+    clk = _Clock()
+    fleet = _stub_fleet(clk)
+    victim = fleet.preferred_replica(7)
+    freq = fleet.submit(_Cfg(7), priority=1)
+    assert freq.replica_trail[-1] == victim
+    fleet.begin_drain(victim)
+    fleet.begin_drain(victim)  # idempotent
+    assert victim in fleet.draining
+    with pytest.raises(KeyError):
+        fleet.begin_drain(999)
+    # routing skips a draining replica immediately...
+    rerouted = fleet.submit(_Cfg(7), priority=1)
+    assert rerouted.replica_trail[-1] != victim
+    # ...but its already-admitted request completes THERE (zero loss)
+    for r in fleet.replicas.values():
+        r.service.pump()
+    _beat(fleet, clk)
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.ok
+    assert freq.result.data[0] == victim
+    assert victim not in fleet.replicas  # retired once its work drained
+    st = fleet.stats()
+    assert st["drains"] == 1 and st["draining"] == []
+    assert st["retired"][0]["reason"] == "drained"
+    assert st["retired"][0]["served"] >= 1
+
+
+def test_fleet_forced_drain_falls_back_to_failover():
+    clk = _Clock()
+    fleet = _stub_fleet(clk, drain_timeout_s=0.5,
+                        failover_backoff_s=0.01)
+    victim = fleet.preferred_replica(3)
+    freq = fleet.submit(_Cfg(3), priority=1)
+    fleet.begin_drain(victim)
+    # the laggard never drains: past drain_timeout_s the fleet revokes
+    # its lease, forcing the zero-loss failover path
+    clk.t += 1.0
+    _beat(fleet, clk)
+    fleet.tick(clk.t)
+    assert fleet.replica(victim).lease.revoked
+    clk.t += 0.5
+    _beat(fleet, clk, exclude={victim})
+    fleet.tick(clk.t)  # monitor sees the revocation: queue strands
+    clk.t += 0.5
+    _beat(fleet, clk, exclude={victim})
+    fleet.tick(clk.t)  # past the backoff: rerouted to a survivor
+    for rid, r in fleet.replicas.items():
+        if rid != victim:
+            r.service.pump()
+    fleet.tick(clk.t)
+    assert freq.done and freq.result.ok
+    assert freq.result.data[0] != victim
+    st = fleet.stats()
+    assert st["failovers"] >= 1
+    assert any(
+        row["reason"] == "dead_during_drain" for row in st["retired"]
+    )
+    assert victim not in fleet.replicas
+
+
+# ---------------------------------------------------------------------------
 # Real-engine integration: threaded fleet, kill, bit-identity
 # ---------------------------------------------------------------------------
 
@@ -624,6 +1019,96 @@ def test_fleet_kill_failover_stays_bit_identical(cover):
         np.testing.assert_array_equal(
             np.asarray(req.result.data),
             np.asarray(fwd_ref.get_subgrid_task(sg)),
+        )
+
+
+def test_fabric_facet_update_rolls_once_every_replica_observes(cover):
+    """The satellite regression pin: a facet update through the SHARED
+    fabric runs `engine.update` ONCE, rolls the fabric ONCE (version
+    bumped exactly once, no per-replica re-record, index preserved on
+    a patch), and EVERY replica observes the new pin — then serves the
+    patched rows from cache, matching a fresh recompute over the new
+    facet stack."""
+    from swiftly_tpu import SwiftlyForward
+    from swiftly_tpu.delta import IncrementalForward
+    from swiftly_tpu.serve import CoalescingScheduler, SubgridService
+
+    config, facet_tasks, sgs = cover
+    engine = IncrementalForward(
+        config, facet_tasks, SpillCache(budget_bytes=2**30)
+    )
+    engine.record(sgs)
+    fabric = engine.fabric(l1_rows=8)
+
+    def factory(rid, feed):
+        fwd = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=50)
+        return SubgridService(
+            fwd, scheduler=CoalescingScheduler(max_batch=8),
+            cache_feed=feed,
+        )
+
+    fleet = ServeFleet(
+        factory, 3, fabric=fabric, lease_interval_s=10.0, seed=11
+    )
+    for r in fleet.replicas.values():
+        r.lease.beat(fleet._clock())
+
+    def serve_all(configs):
+        reqs = [fleet.submit(sg, priority=1) for sg in configs]
+        for r in fleet.replicas.values():
+            while r.service.pump_once():
+                pass
+        fleet.tick()
+        for fr in reqs:
+            assert fr.done and fr.result.ok
+            assert fr.result.path == "cache"
+        return reqs
+
+    probe = sgs[:6]
+    serve_all(probe)
+
+    v_before = fabric.stream_version
+    fills_before = engine.spill.stats()["fills"]
+    # mutate the biggest facet (a zero corner facet would be a noop)
+    mags = [float(np.abs(np.asarray(d)).max()) for _fc, d in facet_tasks]
+    hot = int(np.argmax(mags))
+    assert mags[hot] > 0
+    new_tasks = [
+        (fc, np.asarray(d) * (1.75 if i == hot else 1.0))
+        for i, (fc, d) in enumerate(facet_tasks)
+    ]
+    report = fleet.post_facet_update(engine, new_tasks)
+    assert report["mode"] in ("patch", "replay")
+    # ONE update, ONE roll, version bumped EXACTLY once fleet-wide
+    assert report["stream_version"] == v_before + 1
+    assert fabric.stream_version == v_before + 1
+    assert fabric.rolls == 1
+    for r in fleet.replicas.values():
+        assert r.service.stream_version == v_before + 1
+        assert r.service.cache_feed.stream_version == v_before + 1
+        assert r.service.cache_feed is fabric.view(r.rid)
+    if report["mode"] == "patch":
+        # a patch rewrites payloads in place: no re-record (the fill
+        # counter is untouched) and the shared index survives
+        assert engine.spill.stats()["fills"] == fills_before
+        assert fabric.index_builds == 1
+
+    # the patched stream serves through every view, matching a fresh
+    # engine over the NEW facet stack (allclose: the patch adds a
+    # streamed delta onto recorded rows, so it differs from a direct
+    # recompute by f32 sum-reorder noise only)
+    reqs2 = serve_all(probe)
+    fresh = IncrementalForward(
+        config, new_tasks, SpillCache(budget_bytes=2**30)
+    )
+    fresh.record(sgs)
+    fresh_feed = fresh.feed()
+    for sg, fr in zip(probe, reqs2):
+        np.testing.assert_allclose(
+            np.asarray(fr.result.data),
+            np.asarray(fresh_feed.lookup(sg)),
+            rtol=1e-4, atol=1e-8,
         )
 
 

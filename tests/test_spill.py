@@ -107,6 +107,118 @@ def test_spill_cache_eviction_gives_up():
 
 
 # ---------------------------------------------------------------------------
+# Concurrency: the cache fabric's access pattern
+# ---------------------------------------------------------------------------
+
+
+def test_spill_concurrent_row_reads_vs_patch_and_eviction():
+    """The fabric's real access pattern, stress-tested: >= 4 reader
+    threads hammering `get_row` while the main thread runs repeated
+    `begin_patch`/`patch_entry`/`end_patch` cycles and finally evicts
+    the whole stream (`reset`). The reader–writer gate's contract: a
+    read never observes a torn row (every row is value-uniform before
+    AND after each landed patch), reads racing a patch window bounce
+    with `StreamMidPatch`, eviction degrades to a clean LookupError,
+    and the final payloads carry exactly the patches that ran."""
+    import threading
+    import time
+
+    from swiftly_tpu.utils.spill import StreamMidPatch
+
+    n_entries, rows, row_len, n_readers, n_patches = 4, 6, 64, 4, 10
+    cache = SpillCache(budget_bytes=1e9)
+    cache.begin_fill(tag="stress")
+    for k in range(n_entries):
+        arr = np.full((1, rows, row_len), 100.0 * k, np.float32)
+        assert cache.put([[(s, None) for s in range(rows)]], arr)
+    assert cache.end_fill()
+
+    stop = threading.Event()
+    errors, torn = [], []
+    bounced = [0] * n_readers
+
+    def reader(tid):
+        rng = np.random.default_rng(tid)
+        while not stop.is_set():
+            k = int(rng.integers(n_entries))
+            s = int(rng.integers(rows))
+            try:
+                row = cache.get_row(k, (0, s))
+            except StreamMidPatch:
+                bounced[tid] += 1
+                continue
+            except LookupError:
+                continue  # raced the final reset: clean degradation
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+                return
+            # every patch adds a uniform +1.0, so a consistent row is
+            # value-uniform at ANY time; a mixed row is a torn read
+            if not np.all(row == row.flat[0]):
+                torn.append((k, s))
+
+    threads = [
+        threading.Thread(target=reader, args=(t,), daemon=True)
+        for t in range(n_readers)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(n_patches):
+            cache.begin_patch()
+            try:
+                for k in range(n_entries):
+                    cache.patch_entry(
+                        k, np.ones((1, rows, row_len), np.float32)
+                    )
+            finally:
+                cache.end_patch()
+            time.sleep(0.002)  # give readers a between-patches window
+
+        # deterministic cross-thread bounce: with the mark up, a
+        # non-patcher read must refuse rather than enter the window...
+        cache.begin_patch()
+        try:
+            seen = {}
+
+            def gated_read():
+                try:
+                    cache.get_row(0, (0, 0))
+                    seen["bounced"] = False
+                except StreamMidPatch:
+                    seen["bounced"] = True
+
+            t = threading.Thread(target=gated_read)
+            t.start()
+            t.join(timeout=10.0)
+            assert seen["bounced"] is True
+            # ...while the patcher thread itself still reads base rows
+            assert cache.get_row(0, (0, 0)) is not None
+        finally:
+            cache.end_patch()
+
+        # final payloads: base + exactly n_patches, read back intact
+        for k in range(n_entries):
+            np.testing.assert_array_equal(
+                cache.get(k),
+                np.full((1, rows, row_len), 100.0 * k + n_patches,
+                        np.float32),
+            )
+        assert cache.stats()["patches"] == n_patches * n_entries
+
+        # eviction mid-traffic: readers degrade cleanly, never crash
+        cache.reset()
+        time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    assert not errors, errors
+    assert not torn, f"torn rows observed: {torn[:5]}"
+    assert not cache.complete and len(cache) == 0
+
+
+# ---------------------------------------------------------------------------
 # Cache-fed streaming
 # ---------------------------------------------------------------------------
 
