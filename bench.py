@@ -3200,6 +3200,22 @@ def smoke():
         )
     if "measured_wall_s" not in pc:
         problems.append("plan_compiled missing measured_wall_s")
+    # colpass pedigree: the compiled plan resolves the same forward
+    # column-pass body the executor binds (env + platform at both
+    # sites), so a silent divergence — e.g. a plan priced for pallas
+    # while the stream ran einsum — fails here, on CPU, in seconds
+    executed_colpass = (record.get("plan") or {}).get("colpass")
+    planned_colpass = (pc.get("forward") or {}).get("colpass")
+    if executed_colpass != planned_colpass:
+        problems.append(
+            f"executed plan.colpass {executed_colpass!r} != compiled "
+            f"plan_compiled.forward.colpass {planned_colpass!r}"
+        )
+    if not (pc.get("forward") or {}).get("colpass_candidates"):
+        problems.append(
+            "plan_compiled.forward missing the ranked "
+            "colpass_candidates table"
+        )
     # feed-once/fold-many schema: the executed schedule must match the
     # compiled one, the shared-feed stage must have been recorded, and
     # the h2d byte collapse must be exactly what the schedule promises

@@ -19,7 +19,12 @@ artifact) and exits non-zero when a leg regressed:
   schedule buys is regression-guarded leg-by-leg — higher is better,
   cross-platform pairs are skipped (below), and the doctored-reference
   trip is exercised in tier-1 (tests/test_bench_smoke.py) exactly like
-  the mesh scaling sentinel;
+  the mesh scaling sentinel. Since the fused Pallas column pass, the
+  same check doubles as the FORWARD MFU sentinel: streamed legs stamp
+  ``mfu_pct`` too, each verdict carries the leg's ``colpass`` pedigree
+  (executed ``plan.colpass``, else the compiled prediction), and an
+  MFU problem message names it — a regression that is really a silent
+  pallas→einsum fallback is readable from the verdict alone;
 * **p99 / QPS** — for serving legs (``--serve`` / ``--fleet``
   artifacts): latest ``p99_ms`` more than the threshold above the best
   (lowest) reference p99, or ``throughput_rps`` more than the
@@ -235,6 +240,13 @@ def compare(latest_records, reference_records, threshold=0.2):
                  "platform": platform, "reason": why}
             )
             continue
+        # forward column-pass pedigree: which body this leg actually
+        # ran (executed plan stamp, falling back to the compiled
+        # prediction) — an MFU regression reads differently when the
+        # leg silently fell back from pallas to einsum
+        colpass = (rec.get("plan") or {}).get("colpass") or (
+            (rec.get("plan_compiled") or {}).get("forward") or {}
+        ).get("colpass")
         verdict = {
             "config": key[0],
             "mode": key[1],
@@ -246,6 +258,8 @@ def compare(latest_records, reference_records, threshold=0.2):
             "n_reference_runs": ref["n"],
             "problems": [],
         }
+        if colpass is not None:
+            verdict["colpass"] = colpass
         value = rec.get("value")
         if (
             isinstance(value, (int, float))
@@ -268,6 +282,7 @@ def compare(latest_records, reference_records, threshold=0.2):
                 f"mfu {mfu:.4g}% is "
                 f"{100 * (1 - mfu / ref['mfu']):.1f}% below best "
                 f"reference {ref['mfu']:.4g}%"
+                + (f" (colpass={colpass})" if colpass else "")
             )
         # serving legs (serve/fleet): tail latency + capacity sentinel
         p99 = rec.get("p99_ms")

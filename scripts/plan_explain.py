@@ -128,6 +128,15 @@ def main(argv=None):
              "patch) against the full re-record (plan.plan_delta)",
     )
     ap.add_argument(
+        "--colpass", action="store_true",
+        help="print the ranked forward column-pass candidate table "
+             "instead: einsum vs the fused Pallas kernel, each priced "
+             "with its own FLOP shape and coefficient stage "
+             "(plan.price_colpass_candidates); with --history the "
+             "rates carry measured pedigree and any refit-learned "
+             "block sizes are shown",
+    )
+    ap.add_argument(
         "--cache", action="store_true",
         help="print the serve cache-fabric tier table instead: price a "
              "per-replica L1 hit vs an L2 read of the one resident "
@@ -179,6 +188,58 @@ def main(argv=None):
         fold_group=args.fold_group, max_batch=args.max_batch,
     )
     coeffs = refit(args.history) if args.history else None
+    if args.colpass:
+        from swiftly_tpu.plan import (
+            CostCoefficients,
+            price_colpass_candidates,
+        )
+        from swiftly_tpu.utils.flops import resolve_colpass
+
+        ccoeffs = coeffs if coeffs is not None else CostCoefficients()
+        rows = price_colpass_candidates(inputs, ccoeffs)
+        chosen = resolve_colpass(
+            inputs.base().core,
+            inputs.n_facets // max(1, inputs.n_devices),
+        )
+        if args.as_json:
+            print(json.dumps({
+                "config": name,
+                "chosen": chosen,
+                "coefficients": ccoeffs.source,
+                "colpass_blocks": ccoeffs.colpass_blocks,
+                "candidates": rows,
+            }, indent=2))
+            return 0
+        print(f"forward column-pass candidates for {name} "
+              f"(coefficients: {ccoeffs.source})")
+        print("  rank  colpass  coeff stage              "
+              "TFLOP   TF/s  predicted wall")
+        for i, row in enumerate(rows):
+            mark = " <- resolve_colpass" if row["colpass"] == chosen \
+                else ""
+            print(
+                f"  {i + 1:4d}  {row['colpass']:7s}  "
+                f"{row['coeff_stage']:23s}  "
+                f"{row['flops'] / 1e12:5.1f}  "
+                f"{row['flops_per_s'] / 1e12:5.1f}  "
+                f"{row['predicted_wall_s']:10.2f} s{mark}"
+            )
+        if ccoeffs.colpass_blocks:
+            blk = ccoeffs.colpass_blocks
+            print(
+                "  refit-learned pallas blocks: "
+                + ", ".join(f"{k}={blk[k]}" for k in sorted(blk))
+            )
+        else:
+            print(
+                "  pallas blocks: defaults (bm=bn=bk=256; refit from "
+                "pallas-stamped artifact history to learn better ones)"
+            )
+        print(
+            "  note: the table only RANKS — resolve_colpass keeps the "
+            "choice (SWIFTLY_COLPASS env, platform, backend)"
+        )
+        return 0
     if args.delta is not None:
         try:
             dplan = plan_delta(inputs, args.delta, coeffs=coeffs)

@@ -14,7 +14,11 @@ Stages (32k default):
                per-dispatch latency floor (pure overhead, 0 FLOPs)
   synth      - sparse facet-slab synthesis (scatter into zeros)
   sampled    - the sampled-DFT facet pass einsum for one column group
-  column     - the group column pass (prepare + per-subgrid matmuls)
+  column     - the group column pass (prepare + per-subgrid matmuls),
+               body per resolve_colpass (einsum / fused pallas / fft)
+  column-*   - on planar backends, the OTHER matrix body (einsum vs
+               pallas) timed at the same geometry: the committed
+               evidence row behind the plan's colpass_candidates table
   finish     - the group finish (crop iFFTs + masks)
 
 Usage: python scripts/roofline.py [--config 32k[1]-n16k-512] [--G 8]
@@ -165,13 +169,32 @@ def main():
     colpass = resolve_colpass(core, F)
     foffs0 = jnp.asarray(np.asarray(fwd.stack.offs0))
     foffs1 = jnp.asarray(np.asarray(fwd.stack.offs1))
-    if colpass == "einsum":
+    if colpass in ("einsum", "pallas"):
         # time the kernel the resident executor actually runs: the group
         # column pass (sequential columns, finish folded into the
         # operators) — the slab step at full F with a chunk-wide vmap is
         # a shape the einsum executor never chooses (it would OOM)
         from swiftly_tpu.parallel.streamed import _column_pass_fwd_group_j
 
+        prep_flops = G * F * (fft_flops(yN, m) + 6 * m * yN)  # prep1
+        einsum_col_flops = (
+            prep_flops
+            + G * F * 8 * xM * m * yN  # H = A0 @ NMBF_BF
+            + G * S * 8 * xM * xM * F * m  # stage-2 contraction
+        )
+        # fused kernel: gather commutes past stage 1, no hoisted H —
+        # per subgrid 8*xM*m*(m+xM)*F triple product + the crop iFFTs
+        pallas_col_flops = prep_flops + G * S * (
+            8 * xM * m * (m + xM) * F + 4 * xA * xA
+        )
+        col_notes = {
+            "einsum": f"prepare + operator einsums (K={F * m}) incl. "
+                      f"crop for {G} columns x {S} subgrids "
+                      f"(all {F} facets)",
+            "pallas": f"fused Pallas colpass (prepare + gather + "
+                      f"triple product, K={F * m}) incl. crop for "
+                      f"{G} columns x {S} subgrids (all {F} facets)",
+        }
         gcolfn = _column_pass_fwd_group_j(core, xA)
         so_g = so_c.reshape(G, S, 2)
         m0_g = m0_c.reshape(G, S, -1)
@@ -182,15 +205,63 @@ def main():
 
         dt_column, out = timed(run_col, buf)
         col_flops = (
-            G * F * (fft_flops(yN, m) + 6 * m * yN)  # prep1
-            + G * F * 8 * xM * m * yN  # H = A0 @ NMBF_BF
-            + G * S * 8 * xM * xM * F * m  # stage-2 contraction
+            einsum_col_flops if colpass == "einsum" else pallas_col_flops
         )
         emit("column", dt_column, col_flops,
              bytes_touched=buf.nbytes + out.nbytes,
-             note=f"prepare + operator einsums (K={F * m}) incl. crop "
-                  f"for {G} columns x {S} subgrids (all {F} facets)")
-        dt_fin = 0.0  # folded into the einsum operators (crop+masks
+             note=col_notes[colpass])
+
+        # paired row: the OTHER matrix body at the exact same geometry,
+        # so a single roofline run carries the einsum-vs-pallas evidence
+        # the plan's ranked colpass_candidates table is refit against.
+        # Skipped when the other body is pallas on a CPU backend without
+        # SWIFTLY_PALLAS_INTERPRET=1: pallas_call only lowers natively on
+        # TPU, and an interpret-mode timing is not roofline evidence
+        from swiftly_tpu.ops.pallas_kernels import pallas_interpret
+
+        _other_is_pallas = colpass == "einsum"
+        _can_run_other = not _other_is_pallas or (
+            jax.default_backend() != "cpu" or pallas_interpret()
+        )
+        if getattr(core, "backend", "") == "planar" and _can_run_other:
+            from swiftly_tpu.parallel.streamed import (
+                _colpass_einsum_body,
+                _colpass_operators,
+                _colpass_pallas_body,
+            )
+
+            other = "pallas" if colpass == "einsum" else "einsum"
+            body = (
+                _colpass_pallas_body
+                if other == "pallas"
+                else _colpass_einsum_body
+            )
+            ops_cmp = _colpass_operators(core, foffs0, foffs1)
+
+            @jax.jit
+            def run_other(buf):
+                NMBF_g = jnp.moveaxis(
+                    buf.reshape((F, G, m) + buf.shape[2:]), 1, 0
+                )
+
+                def per_col(xs):
+                    NMBF, so, mk0, mk1 = xs
+                    return body(
+                        core, xA, ops_cmp, NMBF, foffs1, so, mk0, mk1
+                    )
+
+                return jax.lax.map(
+                    per_col, (NMBF_g, so_g, m0_g, m1_g)
+                )
+
+            dt_other, out_other = timed(run_other, buf)
+            emit(f"column-{other}", dt_other,
+                 einsum_col_flops if other == "einsum"
+                 else pallas_col_flops,
+                 bytes_touched=buf.nbytes + out_other.nbytes,
+                 note=col_notes[other] + " [comparison row: body not "
+                      "selected by resolve_colpass on this platform]")
+        dt_fin = 0.0  # folded into the matrix-body operators (crop+masks
         # happen inside the column stage above) — no separate stage
     else:
         stepfn = _column_group_step_j(core, xA, chunk, colpass)
@@ -238,9 +309,10 @@ def main():
     # between the bounds.
     n_groups = -(-len(col_offs0) // G)
     per_group = dt_sampled + dt_column + dt_fin
-    # each timed stage embeds one dispatch+pull; einsum mode has two
-    # stages per group (sampled + column-with-crop), fft mode three
-    n_stages = 2 if colpass == "einsum" else 3
+    # each timed stage embeds one dispatch+pull; the matrix bodies
+    # (einsum/pallas) have two stages per group (sampled +
+    # column-with-crop), fft mode three
+    n_stages = 2 if colpass in ("einsum", "pallas") else 3
     lo = n_groups * (per_group - n_stages * t_lat)
     hi = n_groups * (per_group + 2 * t_lat)
     print(json.dumps({

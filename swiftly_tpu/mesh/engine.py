@@ -49,7 +49,14 @@ engine (the shard_map bodies are built from the same ``*_fn`` builders);
 only the forward column pass's facet-sum REDUCTION ORDER differs (local
 scan per shard + psum vs one scan over all facets), so mesh and
 single-chip results agree to reduction-order tolerance, which
-``bench.py --mesh`` asserts and stamps (docs/multichip.md).
+``bench.py --mesh`` asserts and stamps (docs/multichip.md). That
+contract covers the column-pass BODY choice too: `resolve_colpass`
+(einsum / fused Pallas / fft, SWIFTLY_COLPASS) resolves inside the
+shared builders with the shard-LOCAL facet count, so under the mesh the
+fused Pallas kernel is the same one grid program per shard — it reduces
+the shard's local facets in-kernel (its K loop runs over local F only)
+and the per-column `lax.psum` over the facet axis stays the engine's
+single collective, exactly as in the einsum body.
 
 The pattern is exactly the contraction-over-mesh shape of "Large-Scale
 Discrete Fourier Transform on TPUs" (arXiv 2002.03260) and "Distributed

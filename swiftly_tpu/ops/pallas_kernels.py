@@ -21,6 +21,17 @@ partial products added in place), so each (rows, acc) block pair is
 read once per output tile — the hot loop the reference delegates to
 its native ``ska-sdp-func`` library, here as one Mosaic grid program.
 
+The third kernel, `colpass_pallas`, fuses the forward/backward column
+pass (`parallel.streamed._colpass_einsum_body` and the backward column
+body): the prepare matmul, the K = F·m operator contraction, and the
+complex recombination of each subgrid run as one grid program with the
+output tile resident in VMEM across the facet × contraction sweep, so
+the [F, xM, yN] prepared-facet transient of the einsum chain never
+touches HBM. One kernel serves the forward body, the adjoint body, and
+both shard-local variants under the mesh engine (``reduce_f`` flips
+between the facet-summed forward product and the per-facet backward
+product). Selected via ``SWIFTLY_COLPASS=pallas`` (or ``auto`` on TPU).
+
 Usage is opt-in (``SWIFTLY_PALLAS=1``): correctness is validated in
 interpreter mode on any backend (tests/test_pallas.py), but this
 environment's remote-compile TPU relay cannot compile Mosaic kernels, so
@@ -39,8 +50,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["bwd_fold_pallas", "cmatmul_pallas", "pallas_enabled",
-           "pallas_interpret"]
+__all__ = ["bwd_fold_pallas", "cmatmul_pallas", "colpass_pallas",
+           "pallas_enabled", "pallas_interpret"]
 
 
 def pallas_enabled() -> bool:
@@ -205,3 +216,114 @@ def bwd_fold_pallas(acc_r, acc_i, bc, bs, rr, ri, w, *, bm=256, bn=256,
         interpret=interpret,
     )(ar_p, ai_p, bc_p, bs_p, rr_p, ri_p, w_p)
     return outr[:B, :J], outi[:B, :J]
+
+
+def _colpass_kernel(ar_ref, ai_ref, xr_ref, xi_ref, br_ref, bi_ref,
+                    or_ref, oi_ref, *, reduce_f):
+    """One fused column-pass output tile: out (+)= A_f @ X_sf @ B_f.
+
+    The grid iterates (s, i, j, f, k) with f/k innermost, so the output
+    tile stays resident in VMEM across the whole facet × contraction
+    sweep — the prepare matmul (dot #1) and the operator contraction
+    (dot #2) never round-trip a partial through HBM, which is what the
+    separate XLA einsum dispatches in `_colpass_einsum_body` cost us.
+    With ``reduce_f`` the facet axis folds into the accumulator
+    (forward body: P_s = Σ_f A0_f @ Xn_sf @ B1_f); without it each
+    facet writes its own output plane (backward body: Z_sf)."""
+    f = pl.program_id(3)
+    k = pl.program_id(4)
+    first = (f == 0) & (k == 0) if reduce_f else k == 0
+
+    @pl.when(first)
+    def _init():
+        or_ref[...] = jnp.zeros_like(or_ref)
+        oi_ref[...] = jnp.zeros_like(oi_ref)
+
+    ar = ar_ref[0]     # [bm, P]
+    ai = ai_ref[0]
+    xr = xr_ref[0, 0]  # [P, bk]
+    xi = xi_ref[0, 0]
+    br = br_ref[0]     # [bk, bn]
+    bi = bi_ref[0]
+    # HIGHEST matches the einsum body's matmul_precision default
+    dot = functools.partial(
+        jnp.dot,
+        preferred_element_type=or_ref.dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    tr = dot(ar, xr) - dot(ai, xi)  # [bm, bk]
+    ti = dot(ar, xi) + dot(ai, xr)
+    pr = dot(tr, br) - dot(ti, bi)  # [bm, bn]
+    pi = dot(tr, bi) + dot(ti, br)
+    or_ref[...] += pr.reshape(or_ref.shape)
+    oi_ref[...] += pi.reshape(oi_ref.shape)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reduce_f", "bm", "bn", "bk", "interpret")
+)
+def colpass_pallas(ar, ai, xr, xi, br, bi, *, reduce_f=True, bm=256,
+                   bn=256, bk=256, interpret=False):
+    """Fused complex triple product A_f @ X_sf @ B_f over an S block.
+
+    The column pass's whole per-subgrid contraction — prepare matmul,
+    operator einsums, complex recombination — as ONE grid program:
+
+    * forward body: A = A0 [F, xM, m], X = gathered facet columns
+      [S, F, m, m], B = B1 [F, m, xM], ``reduce_f=True`` →
+      out [S, xM, xM] (facet sum folded into the VMEM accumulator).
+      Dot #1 IS the prepare matmul, so the [F, xM, yN] H transient of
+      the einsum body never exists.
+    * backward body: A = E0 [F, m, xM], X = embedded subgrids
+      [S, 1, xM, xM] (broadcast over f), B = E1 [F, xM, m],
+      ``reduce_f=False`` → out [S, F, m, m].
+
+    :param ar, ai: [F, M, P] left operator planes
+    :param xr, xi: [S, Fx, P, Q] per-subgrid middle planes; Fx is F or
+        1 (broadcast over the facet axis)
+    :param br, bi: [F, Q, N] right operator planes
+    :param reduce_f: sum over the facet axis into the accumulator
+    :param bm, bn, bk: tile sizes (M rows, N cols, Q contraction); the
+        P contraction runs whole per grid step (padded to 128 lanes)
+    :param interpret: run in the Pallas interpreter (any backend)
+    """
+    F, M, P = ar.shape
+    S, Fx = xr.shape[0], xr.shape[1]
+    Q, N = br.shape[1], br.shape[2]
+    bm, bn, bk = min(bm, M), min(bn, N), min(bk, Q)
+
+    ar_p = _pad_to(_pad_to(ar, bm, 1), 128, 2)
+    ai_p = _pad_to(_pad_to(ai, bm, 1), 128, 2)
+    xr_p = _pad_to(_pad_to(xr, 128, 2), bk, 3)
+    xi_p = _pad_to(_pad_to(xi, 128, 2), bk, 3)
+    br_p = _pad_to(_pad_to(br, bk, 1), bn, 2)
+    bi_p = _pad_to(_pad_to(bi, bk, 1), bn, 2)
+    Mp, Pp = ar_p.shape[1], ar_p.shape[2]
+    Qp, Np = br_p.shape[1], br_p.shape[2]
+
+    grid = (S, Mp // bm, Np // bn, F, Qp // bk)
+    a_spec = pl.BlockSpec((1, bm, Pp), lambda s, i, j, f, k: (f, i, 0))
+    if Fx == 1:
+        x_spec = pl.BlockSpec(
+            (1, 1, Pp, bk), lambda s, i, j, f, k: (s, 0, 0, k))
+    else:
+        x_spec = pl.BlockSpec(
+            (1, 1, Pp, bk), lambda s, i, j, f, k: (s, f, 0, k))
+    b_spec = pl.BlockSpec((1, bk, bn), lambda s, i, j, f, k: (f, k, j))
+    if reduce_f:
+        o_spec = pl.BlockSpec((1, bm, bn), lambda s, i, j, f, k: (s, i, j))
+        out_shape = jax.ShapeDtypeStruct((S, Mp, Np), ar.dtype)
+    else:
+        o_spec = pl.BlockSpec(
+            (1, 1, bm, bn), lambda s, i, j, f, k: (s, f, i, j))
+        out_shape = jax.ShapeDtypeStruct((S, F, Mp, Np), ar.dtype)
+
+    outr, outi = pl.pallas_call(
+        functools.partial(_colpass_kernel, reduce_f=reduce_f),
+        grid=grid,
+        in_specs=[a_spec, a_spec, x_spec, x_spec, b_spec, b_spec],
+        out_specs=[o_spec, o_spec],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(ar_p, ai_p, xr_p, xi_p, br_p, bi_p)
+    return outr[..., :M, :N], outi[..., :M, :N]

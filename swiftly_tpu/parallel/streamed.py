@@ -175,7 +175,19 @@ def _jit(static=(), donate=()):
 
 
 def _shmap(fn, mesh, in_specs, out_specs, donate=()):
-    mapped = _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    # check_rep=False: jax has no replication rule for pallas_call, so
+    # the rep checker rejects any body that lowers the fused colpass
+    # kernel (SWIFTLY_COLPASS=pallas under the mesh engine). The psum
+    # placement is pinned by the body builders themselves.
+    try:
+        mapped = _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+    except TypeError:  # pragma: no cover - jax without check_rep kwarg
+        mapped = _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+        )
     return jax.jit(mapped, donate_argnums=donate)
 
 
@@ -238,14 +250,16 @@ def _facet_pass_fwd_sharded(core, mesh):
 # existing `*_math` chain to an identity block — correctness by
 # construction, ~1 ms per program, and both spmd modes reuse the body.
 #
-# `SWIFTLY_COLPASS` selects the body (einsum|fft|auto, default auto; read
-# at TRACE time like SWIFTLY_PRECISION — the lru-cached jits bake it in).
-# "auto" resolves per program via `utils.flops.resolve_colpass`; the
-# einsum body measured faster at EVERY forward shape tried (resident
-# full-stack AND Fg=1 slabs), so auto currently picks einsum everywhere
-# — the contraction-depth threshold there is the tuning point should a
-# shallower shape regress. The BACKWARD pass defaults to the fft chain
-# (`resolve_colpass_bwd`): its adjoint einsums measured slower.
+# `SWIFTLY_COLPASS` selects the body (einsum|fft|pallas|auto, default
+# auto; read at TRACE time like SWIFTLY_PRECISION — the lru-cached jits
+# bake it in). "auto" resolves per program via
+# `utils.flops.resolve_colpass`: the fused Pallas kernel on TPU (the
+# whole per-subgrid triple product A0 @ Xn @ B1 as one grid program,
+# `ops.pallas_kernels.colpass_pallas` — no [F, xM, yN] H transient, no
+# per-einsum dispatch gaps), einsum elsewhere (it measured faster than
+# the fft chain at EVERY forward shape tried, resident full-stack AND
+# Fg=1 slabs). The BACKWARD pass (`resolve_colpass_bwd`) follows the
+# same auto rule (pallas on TPU, einsum otherwise).
 
 
 from ..utils.flops import (  # noqa: E402
@@ -263,6 +277,22 @@ def _colpass_sblock() -> int:
     import os
 
     return max(1, int(os.environ.get("SWIFTLY_COLPASS_SBLOCK", "256")))
+
+
+def _colpass_blocks():
+    """(bm, bn, bk) tile sizes for the fused Pallas column-pass kernel
+    (`SWIFTLY_COLPASS_BM/BN/BK`, default 256 each — xM/m fit in one or
+    two MXU-aligned tiles at every catalogue scale). Read at TRACE time;
+    `plan/autotune.refit` learns measured-best blocks from artifact
+    history and `scripts/plan_explain.py --colpass` prints them so
+    operators can export the env."""
+    import os
+
+    return (
+        max(8, int(os.environ.get("SWIFTLY_COLPASS_BM", "256"))),
+        max(8, int(os.environ.get("SWIFTLY_COLPASS_BN", "256"))),
+        max(8, int(os.environ.get("SWIFTLY_COLPASS_BK", "256"))),
+    )
 
 
 def _ceinsum(core, spec, a, b):
@@ -376,6 +406,83 @@ def _colpass_einsum_body(
     return jax.vmap(fin)(P, sg_offs, masks0, masks1)
 
 
+def _colpass_pallas_body(
+    core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0, masks1,
+    axis_name=None, finish=True, interpret=None,
+):
+    """One column through the FUSED Pallas column pass.
+
+    The same contraction as `_colpass_einsum_body`, reassociated per
+    subgrid: P_s = Σ_f A0_f @ Xn_sf @ B1_f, where Xn_sf gathers the
+    subgrid's m columns from NMBF_BF directly — the gather acts on the
+    output (j) axis of H = A0 @ NMBF_BF, so it commutes past the
+    stage-1 contraction and the [F, xM, yN] H transient (~2.4 GB at
+    128k) never materialises; the gather transient shrinks from
+    [Sb, F, xM, m] to [Sb, F, m, m]. Prepare matmul, K = F*m operator
+    contraction and the complex recombination run as ONE grid program
+    with the output tile resident in VMEM (`colpass_pallas`,
+    reduce_f=True). Pre-finish partials and the crop finish are
+    identical to the einsum body's (image space), so the two bodies are
+    drop-in interchangeable for every caller — including the group
+    step/finish pairing and the shard-local psum placement.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.pallas_kernels import colpass_pallas, pallas_interpret
+
+    p = core._p
+    m, yN = core.xM_yN_size, core.yN_size
+    A0, B1 = ops
+    if interpret is None:
+        interpret = pallas_interpret()
+    bm, bn, bk = _colpass_blocks()
+
+    def prep1(x, off1):
+        return prepare_facet_math(p, core._Fb, yN, x, off1, 1)
+
+    NMBF_BF = jax.vmap(prep1)(NMBF, foffs1)  # [F, m, yN, 2]
+
+    def block(so_blk):
+        def gather(so):
+            return extract_from_facet_math(
+                p, m, core.N, yN, NMBF_BF, so[1], 2
+            )  # [F, m, m, 2]
+
+        Xn = jax.vmap(gather)(so_blk)  # [Sb, F, m, m, 2]
+        Pr, Pi = colpass_pallas(
+            A0[..., 0], A0[..., 1],
+            Xn[..., 0], Xn[..., 1],
+            B1[..., 0], B1[..., 1],
+            reduce_f=True, bm=bm, bn=bn, bk=bk, interpret=interpret,
+        )
+        return jnp.stack([Pr, Pi], axis=-1)  # [Sb, xM, xM, 2]
+
+    S = sg_offs.shape[0]
+    Sb = min(_colpass_sblock(), S)
+    nb = -(-S // Sb)
+    Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
+    if nb == 1:
+        P = block(sg_offs)
+    else:
+        pad = nb * Sb - S
+        so_p = (
+            jnp.concatenate([sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)])
+            if pad
+            else sg_offs
+        )
+        P = jax.lax.map(block, so_p.reshape((nb, Sb) + so_p.shape[1:]))
+        P = P.reshape((nb * Sb,) + P.shape[2:])[:S]
+    if axis_name is not None:
+        P = jax.lax.psum(P, axis_name)
+    if not finish:
+        return P
+
+    def fin(Pi_, so, m0, m1):
+        return _crop_masked_subgrid(core, Pi_, so, subgrid_size, m0, m1)
+
+    return jax.vmap(fin)(P, sg_offs, masks0, masks1)
+
+
 def _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name=None, finish=True):
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
         ops = _colpass_operators(core, foffs0, foffs1)
@@ -387,26 +494,36 @@ def _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name=None, finish=True):
     return fn
 
 
+def _column_pass_fwd_pallas_fn(core, subgrid_size, axis_name=None, finish=True):
+    def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
+        ops = _colpass_operators(core, foffs0, foffs1)
+        return _colpass_pallas_body(
+            core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0,
+            masks1, axis_name, finish,
+        )
+
+    return fn
+
+
 def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
 
-    Trace-time dispatcher: the operator-matrix einsum body when the
-    program's facet count makes its stage-2 contraction MXU-deep
-    (`resolve_colpass`), the per-facet fft chain otherwise. Callers that
-    need PRE-finish partials (the facet-slab group step) pick a body
-    explicitly instead — the two bodies' partials live in different
-    spaces (einsum: image, fft: grid) and must pair with the matching
-    group finish.
+    Trace-time dispatcher: the fused Pallas kernel or the
+    operator-matrix einsum body per `resolve_colpass` (both share the
+    image-space partial/crop-finish contract), the per-facet fft chain
+    otherwise. Callers that need PRE-finish partials (the facet-slab
+    group step) pick a body explicitly instead — the fft body's
+    partials live in a different space (grid, not image) and must pair
+    with the matching group finish.
     """
-    ein = _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name)
-    fft_body = _column_pass_fwd_fft_fn(core, subgrid_size, axis_name)
+    bodies = {
+        "einsum": _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name),
+        "pallas": _column_pass_fwd_pallas_fn(core, subgrid_size, axis_name),
+        "fft": _column_pass_fwd_fft_fn(core, subgrid_size, axis_name),
+    }
 
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
-        body = (
-            ein
-            if _resolve_colpass(core, NMBF.shape[0]) == "einsum"
-            else fft_body
-        )
+        body = bodies[_resolve_colpass(core, NMBF.shape[0])]
         return body(NMBF, foffs0, foffs1, sg_offs, masks0, masks1)
 
     return fn
@@ -513,16 +630,22 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
             buf.reshape((F, G, m) + buf.shape[2:]), 1, 0
         )  # [G, F, m, yB(,2)]
 
-        if _resolve_colpass(core, F) == "einsum":
+        mode = _resolve_colpass(core, F)
+        if mode in ("einsum", "pallas"):
             # operators hoisted across the group's columns; columns run
             # sequentially (lax.map) — each column's einsums are already
             # MXU-wide, and a G-batched vmap would scale the [F, xM, yN]
             # H transient by G (OOM at 32k G=9)
             ops = _colpass_operators(core, foffs0, foffs1)
+            body = (
+                _colpass_einsum_body
+                if mode == "einsum"
+                else _colpass_pallas_body
+            )
 
             def per_col(xs):
                 NMBF, so, m0, m1 = xs
-                return _colpass_einsum_body(
+                return body(
                     core, subgrid_size, ops, NMBF, foffs1, so, m0, m1,
                     axis_name,
                 )
@@ -638,11 +761,18 @@ def _bwd_colpass_operators(core, foffs0, foffs1):
     return jax.vmap(e0)(foffs0), jax.vmap(e1)(foffs1)
 
 
-def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
+def _column_pass_bwd_einsum_fn(
+    core, facet_size, axis_name=None, use_pallas=False
+):
     """Operator-matrix backward column pass (adjoint of the forward
     einsum pass): the per-(facet, subgrid) extract chains collapse into
     two K=xM einsums; the per-subgrid scatter into the [F, m, yN]
-    accumulator stays a scan (its positions are per-subgrid)."""
+    accumulator stays a scan (its positions are per-subgrid).
+
+    ``use_pallas`` swaps the per-block einsum pair for the fused kernel
+    (`colpass_pallas`, reduce_f=False: Z_sf = E0_f @ emb_s @ E1_f with
+    the embedded subgrid broadcast over the facet axis) — everything
+    around it (Sb blocking, scatter, finish) is shared."""
     import jax.numpy as jnp
 
     p = core._p
@@ -674,6 +804,20 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
         def block(xs):
             sg_blk, so_blk = xs
             emb = jax.vmap(emb_one)(sg_blk, so_blk)  # [Sb, xM, xM(,2)]
+            if use_pallas:
+                from ..ops.pallas_kernels import (
+                    colpass_pallas, pallas_interpret,
+                )
+
+                bm, bn, bk = _colpass_blocks()
+                Zr, Zi = colpass_pallas(
+                    E0[..., 0], E0[..., 1],
+                    emb[:, None, ..., 0], emb[:, None, ..., 1],
+                    E1[..., 0], E1[..., 1],
+                    reduce_f=False, bm=bm, bn=bn, bk=bk,
+                    interpret=pallas_interpret(),
+                )
+                return jnp.stack([Zr, Zi], axis=-1)  # [Sb, F, m, m, 2]
             Y = _ceinsum(core, "fia,sab->sfib", E0, emb)
             return _ceinsum(core, "sfib,fbj->sfij", Y, E1)  # [Sb,F,m,m]
 
@@ -703,19 +847,20 @@ def _column_pass_bwd_einsum_fn(core, facet_size, axis_name=None):
 def _column_pass_bwd_fn(core, facet_size, axis_name=None):
     """A column's subgrids [S, xA, xA] -> NAF_BMNAF rows [F, m, yB].
 
-    Trace-time dispatcher (einsum vs fft chain) on the program's facet
-    count — `resolve_colpass_bwd`, overridable with SWIFTLY_COLPASS_BWD.
-    Both bodies produce identical finished rows, so unlike the forward
-    no caller pairing is needed."""
-    ein = _column_pass_bwd_einsum_fn(core, facet_size, axis_name)
-    fft_body = _column_pass_bwd_fft_fn(core, facet_size, axis_name)
+    Trace-time dispatcher (einsum vs fused-pallas vs fft chain) on the
+    program's facet count — `resolve_colpass_bwd`, overridable with
+    SWIFTLY_COLPASS_BWD. All bodies produce identical finished rows, so
+    unlike the forward no caller pairing is needed."""
+    bodies = {
+        "einsum": _column_pass_bwd_einsum_fn(core, facet_size, axis_name),
+        "pallas": _column_pass_bwd_einsum_fn(
+            core, facet_size, axis_name, use_pallas=True
+        ),
+        "fft": _column_pass_bwd_fft_fn(core, facet_size, axis_name),
+    }
 
     def fn(subgrids, sg_offs, foffs0, foffs1, masks1):
-        body = (
-            ein
-            if _resolve_colpass_bwd(core, foffs0.shape[0]) == "einsum"
-            else fft_body
-        )
+        body = bodies[_resolve_colpass_bwd(core, foffs0.shape[0])]
         return body(subgrids, sg_offs, foffs0, foffs1, masks1)
 
     return fn
@@ -1909,16 +2054,20 @@ def _column_group_step_fn(core, subgrid_size, chunk, colpass):
     accumulated — finishing per slab cost n_slabs-1 extra finish passes,
     44% of all FLOPs at 64k.
 
-    `colpass` (einsum|fft) is EXPLICIT here: the two bodies accumulate
-    partials in different spaces (image vs grid), so the executor
-    resolves the choice once (from its facet_group) and passes the same
-    value to this step and to `_column_group_finish_j`.
+    `colpass` (einsum|pallas|fft) is EXPLICIT here: the fft body
+    accumulates partials in a different space (grid, vs image for
+    einsum/pallas), so the executor resolves the choice once (from its
+    facet_group) and passes the same value to this step and to
+    `_column_group_finish_j`.
     """
     m = core.xM_yN_size
-    einsum_mode = colpass == "einsum"
+    matrix_mode = colpass in ("einsum", "pallas")
     colfn = (
-        None if einsum_mode
+        None if matrix_mode
         else _column_pass_fwd_fft_fn(core, subgrid_size, finish=False)
+    )
+    matrix_body = (
+        _colpass_einsum_body if colpass == "einsum" else _colpass_pallas_body
     )
 
     def fn(acc, buf, foffs0, foffs1, sg_offs_g):
@@ -1930,12 +2079,12 @@ def _column_group_step_fn(core, subgrid_size, chunk, colpass):
         )  # [G, Fg, m, yB(,2)]
         NMBF_c = NMBF_g.reshape((n_chunks, acc.shape[1]) + NMBF_g.shape[1:])
 
-        if einsum_mode:
+        if matrix_mode:
             # operator build hoisted out of the chunk scan (loop-invariant)
             ops = _colpass_operators(core, foffs0, foffs1)
 
             def one_col(nm, so):
-                return _colpass_einsum_body(
+                return matrix_body(
                     core, subgrid_size, ops, nm, foffs1, so, None, None,
                     finish=False,
                 )
@@ -2001,13 +2150,13 @@ def _fused_sparse_slab_step_j(core, subgrid_size, chunk, Fg, yB, colpass):
 def _column_group_finish_fn(core, subgrid_size, colpass):
     """Finish a whole group's accumulated partials in one program:
     [n_chunks, chunk, S, xM, xM(,2)] -> finished subgrids
-    [n_chunks, chunk, S, xA, xA(,2)]. The einsum column pass accumulates
-    IMAGE-space partials (iFFTs folded into its operators), so its
-    finish is crop + masks; the fft pass accumulates grid-space partials
-    and finishes with the crop iFFTs. `colpass` must be the value the
-    executor passed to the `_column_group_step_fn` that filled the
-    accumulator."""
-    einsum_mode = colpass == "einsum"
+    [n_chunks, chunk, S, xA, xA(,2)]. The einsum and pallas column
+    passes accumulate IMAGE-space partials (iFFTs folded into their
+    operators), so their finish is crop + masks; the fft pass
+    accumulates grid-space partials and finishes with the crop iFFTs.
+    `colpass` must be the value the executor passed to the
+    `_column_group_step_fn` that filled the accumulator."""
+    einsum_mode = colpass in ("einsum", "pallas")
 
     def fn(acc, sg_offs_g, masks0_g, masks1_g):
         def fin(summed, so, m0, m1):
@@ -2909,6 +3058,15 @@ class StreamedForward:
                 core, base.stack.n_total // _mesh_size(base.mesh)
             ),
         }
+        if self.last_plan["colpass"] == "pallas":
+            bm, bn, bk = _colpass_blocks()
+            self.last_plan["colpass_blocks"] = {
+                "bm": bm, "bn": bn, "bk": bk,
+                "sblock": _colpass_sblock(),
+            }
+        colpass_stage = "fwd.column_pass" + (
+            ".pallas" if self.last_plan["colpass"] == "pallas" else ""
+        )
         if base.mesh is not None:
             self.last_plan["mesh_shards"] = _mesh_size(base.mesh)
             samfn = _facet_pass_sampled_sharded(
@@ -2983,7 +3141,7 @@ class StreamedForward:
                 ):
                     buf = samfn(*self._dev_facets, e0, krows)
                 with _metrics.stage(
-                    "fwd.column_pass", flops=cp_flops,
+                    colpass_stage, flops=cp_flops,
                     bytes_moved=coll_bytes,
                 ):
                     out_g = gcolfn(
@@ -3006,6 +3164,8 @@ class StreamedForward:
                     ),
                 )
                 _metrics.count("fwd.column_groups")
+                if self.last_plan["colpass"] == "pallas":
+                    _metrics.count("fwd.pallas_cols", len(grp))
             if whole_groups:
                 yield _whole_group_yield(groups, grp, G, out_g)
                 continue
@@ -3111,6 +3271,20 @@ class StreamedForward:
             )
         n_chunks = G // chunk
         colpass = _resolve_colpass(core, Fg)
+        n_groups = -(-len(col_offs0) // G)
+        # triple-buffered streaming: a background thread fills staging
+        # buffer (d+1) % 3 (pure host memcpy) while the main thread
+        # dispatches slab d's async h2d and compute — h2d(k+1) ∥
+        # compute(k) ∥ d2h(k-1). Disabled for the sparse-synth path (no
+        # host staging exists) and via SWIFTLY_STREAM_PREFETCH=0.
+        import os as _os
+
+        use_prefetch = (
+            not self._facets_sparse
+            and _os.environ.get("SWIFTLY_STREAM_PREFETCH", "1") != "0"
+            and n_slabs * n_groups > 1
+        )
+        n_stage = 3 if use_prefetch else 2
         self.last_plan = {
             "mode": "grouped", "col_group": G, "facet_group": Fg,
             "n_slabs": n_slabs, "slab_depth": depth,
@@ -3118,7 +3292,14 @@ class StreamedForward:
                 "device-synth-sparse" if self._facets_sparse else "host"
             ),
             "colpass": colpass,
+            "stream_prefetch": use_prefetch,
         }
+        if colpass == "pallas":
+            bm, bn, bk = _colpass_blocks()
+            self.last_plan["colpass_blocks"] = {
+                "bm": bm, "bn": bn, "bk": bk,
+                "sblock": _colpass_sblock(),
+            }
         fp_flops = step_flops = coll_bytes = 0
         if _metrics.enabled():
             from ..utils.flops import (
@@ -3150,13 +3331,17 @@ class StreamedForward:
         )
         e0 = (offs0 - yB // 2).astype(np.int32)
 
-        # Double-buffered host staging: building a fresh np.stack per
-        # slab grows host RSS by one slab per dispatch at hour scale
+        # Rotating host staging: building a fresh np.stack per slab
+        # grows host RSS by one slab per dispatch at hour scale
         # (slab-sized arenas are retained, and async h2d can pin
         # buffers) — fatal at 64k where a slab is 2 GB and a pass uploads
-        # ~70 of them. Two persistent buffers alternate instead; reuse is
-        # safe because slab i-2's checksum was pulled (its transfer AND
-        # compute finished) before buffer i%2 is overwritten.
+        # ~70 of them. A fixed ring of persistent buffers rotates
+        # instead: two without the prefetch thread (buffer i%2 reused
+        # only after slab i-2's checksum — transfer AND compute — was
+        # pulled), three with it (the worker refills buffer (d+1)%3
+        # while slab d dispatches; that buffer was last used by slab
+        # d-2, whose checksum the depth-2 drain pulled before slab d
+        # dispatched, so the h2d engine is done reading it).
         n_planes = 2 if (_planar(core) and not self._facets_real) else 1
         stage = (
             None
@@ -3166,12 +3351,12 @@ class StreamedForward:
                     np.empty((Fg, yB, yB), dtype=_np_dtype(core))
                     for _ in range(n_planes)
                 ]
-                for _ in range(2)
+                for _ in range(n_stage)
             ]
         )
 
-        def host_slab(s0, parity):
-            bufs = stage[parity]
+        def host_slab(s0, slot):
+            bufs = stage[slot]
             for k in range(Fg):
                 i = s0 + k
                 for pi, buf in enumerate(bufs):
@@ -3199,145 +3384,209 @@ class StreamedForward:
         # slab i-2's column step (8-byte checksum pull — block_until_ready
         # is not completion on tunnel runtimes), bounding live slabs to 2.
         pending = collections.deque()
-        n_slab_dispatch = 0  # continuous across groups: staging parity
+        n_slab_dispatch = 0  # continuous across groups: staging slot
+        total_dispatch = n_slabs * n_groups
+        # the prefetch worker fills by GLOBAL dispatch index: every group
+        # sweeps the same s0 sequence, so slab d stages facet rows
+        # (d % n_slabs) * Fg regardless of which group consumes it
+        tctx = _trace.current()
+
+        def _fill(d):
+            if _trace.current() != tctx:
+                _trace.adopt(tctx)
+            with _metrics.stage("fwd.slab_prefetch"):
+                return host_slab((d % n_slabs) * Fg, d % n_stage)
+
+        prefetch_ex = None
+        prefetch_fut = None  # (dispatch index, future)
+        if use_prefetch:
+            import concurrent.futures
+
+            prefetch_ex = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="swiftly-slab-stage"
+            )
+            prefetch_fut = (0, prefetch_ex.submit(_fill, 0))
         t_start = time.time()
         logger.info(
             "grouped stream: %d columns in groups of %d (chunk %d), "
-            "%d facet slabs of %d per group",
+            "%d facet slabs of %d per group%s",
             len(col_offs0), G, chunk, n_slabs, Fg,
+            " (prefetch thread)" if use_prefetch else "",
         )
-        for g0 in range(0, len(col_offs0), G):
-            grp = col_offs0[g0 : g0 + G]
-            # one trace span per column group (the tentpole hierarchy:
-            # run → leg → pass → COLUMN GROUP → stage); entered/exited
-            # explicitly so it closes BEFORE the yield — contextvars
-            # set in a generator are visible to the consumer between
-            # yields, and the consumer's spans must not nest in here
-            grp_span = _trace.span(
-                "fwd.column_group", cat="fwd",
-                group=g0 // G, n_cols=len(grp),
-            )
-            grp_span.__enter__()
-            grp_padded = grp + [grp[-1]] * (G - len(grp))
-            krows = jnp.asarray(sampled_row_indices(core, grp_padded))
-            sg_offs_g, m0_g, m1_g = [], [], []
-            for off0 in grp_padded:
-                prog_items = groups[off0]  # incl. zero-mask padding
-                sg_offs_g.append(
-                    [(sg.off0, sg.off1) for _, sg in prog_items]
+        try:
+            for g0 in range(0, len(col_offs0), G):
+                grp = col_offs0[g0 : g0 + G]
+                # one trace span per column group (the tentpole hierarchy:
+                # run → leg → pass → COLUMN GROUP → stage); entered/exited
+                # explicitly so it closes BEFORE the yield — contextvars
+                # set in a generator are visible to the consumer between
+                # yields, and the consumer's spans must not nest in here
+                grp_span = _trace.span(
+                    "fwd.column_group", cat="fwd",
+                    group=g0 // G, n_cols=len(grp),
                 )
-                ms = [_subgrid_masks(sg) for _, sg in prog_items]
-                m0_g.append([mk[0] for mk in ms])
-                m1_g.append([mk[1] for mk in ms])
-
-            def _chunked(x, dt=None):
-                a = jnp.asarray(np.asarray(x), dt)
-                return a.reshape((n_chunks, chunk) + a.shape[1:])
-
-            so_c = _chunked(sg_offs_g)
-            m0_c = _chunked(m0_g, rdt)
-            m1_c = _chunked(m1_g, rdt)
-            # PRE-finish accumulator ([.., xM, xM], 1.31x the finished
-            # size): the finish runs once per group, not once per slab
-            acc = jnp.zeros(
-                (n_chunks, chunk, S, xM, xM) + tail, dtype=_np_dtype(core)
-            )
-            slab_dev = None
-            for s0 in range(0, F_pad, Fg):
-                while len(pending) >= depth:
-                    with _metrics.stage("fwd.drain"):
-                        np.asarray(pending.popleft())
-                # drop the previous slab BEFORE uploading the next: at
-                # depth 1 both must never be live together
-                # parity from a CONTINUOUS dispatch counter, not the
-                # per-group slab index: with odd slabs-per-group a
-                # group-local parity would reuse the buffer of the
-                # previous group's final slab before its checksum (h2d +
-                # compute completion) was pulled
-                slab_dev = None  # noqa: F841 - releases device buffers
-                if fusedfn is not None:
-                    # one dispatch: synth + sampled pass + column step
-                    with _metrics.stage(
-                        "fwd.slab_step",
-                        flops=fp_flops + step_flops,
-                        bytes_moved=coll_bytes,
-                    ):
-                        acc = fusedfn(
-                            acc,
-                            *self._sparse_pixels(s0, s0 + Fg),
-                            jnp.asarray(e0[s0 : s0 + Fg]),
-                            krows,
-                            jnp.asarray(offs0[s0 : s0 + Fg]),
-                            jnp.asarray(offs1[s0 : s0 + Fg]),
-                            so_c,
-                        )
-                else:
-                    with _metrics.stage("fwd.slab_upload") as st:
-                        slab_dev = tuple(
-                            base._place(a)
-                            for a in host_slab(s0, n_slab_dispatch % 2)
-                        )
-                        st.bytes_moved = sum(
-                            int(a.nbytes) for a in slab_dev
-                        )
-                    with _metrics.stage(
-                        "fwd.sampled_facet_pass", flops=fp_flops
-                    ):
-                        buf = samfn(
-                            *slab_dev,
-                            jnp.asarray(e0[s0 : s0 + Fg]),
-                            krows,
-                        )
-                    with _metrics.stage(
-                        "fwd.slab_step",
-                        flops=step_flops,
-                        bytes_moved=coll_bytes,
-                    ):
-                        acc = stepfn(
-                            acc,
-                            buf,
-                            jnp.asarray(offs0[s0 : s0 + Fg]),
-                            jnp.asarray(offs1[s0 : s0 + Fg]),
-                            so_c,
-                        )
-                n_slab_dispatch += 1
-                pending.append(jnp.sum(acc))
-                if logger.isEnabledFor(logging.INFO):
-                    logger.info(
-                        "  group %d/%d slab %d/%d dispatched  t=%.0fs "
-                        "rss=%.1fGiB",
-                        g0 // G + 1, -(-len(col_offs0) // G),
-                        s0 // Fg + 1, n_slabs,
-                        time.time() - t_start, _rss_gib(),
+                grp_span.__enter__()
+                grp_padded = grp + [grp[-1]] * (G - len(grp))
+                krows = jnp.asarray(sampled_row_indices(core, grp_padded))
+                sg_offs_g, m0_g, m1_g = [], [], []
+                for off0 in grp_padded:
+                    prog_items = groups[off0]  # incl. zero-mask padding
+                    sg_offs_g.append(
+                        [(sg.off0, sg.off1) for _, sg in prog_items]
                     )
-            # finish the whole group in one program (acc freed by the
-            # `del` below — donation can't alias it into the cropped
-            # output; the runtime orders the finish after the pending
-            # slab steps on the same buffer, and the depth-2 checksum
-            # pipeline keeps bounding live slabs)
-            with _metrics.stage("fwd.group_finish"):
-                finished = finfn(acc, so_c, m0_c, m1_c)
-            del acc
-            grp_span.__exit__(None, None, None)
-            if _metrics.enabled():
-                _metrics.count(
-                    "fwd.subgrids",
-                    sum(
-                        1
-                        for off0 in grp
-                        for it in groups[off0]
-                        if it[0] is not None
-                    ),
+                    ms = [_subgrid_masks(sg) for _, sg in prog_items]
+                    m0_g.append([mk[0] for mk in ms])
+                    m1_g.append([mk[1] for mk in ms])
+
+                def _chunked(x, dt=None):
+                    a = jnp.asarray(np.asarray(x), dt)
+                    return a.reshape((n_chunks, chunk) + a.shape[1:])
+
+                so_c = _chunked(sg_offs_g)
+                m0_c = _chunked(m0_g, rdt)
+                m1_c = _chunked(m1_g, rdt)
+                # PRE-finish accumulator ([.., xM, xM], 1.31x the finished
+                # size): the finish runs once per group, not once per slab
+                acc = jnp.zeros(
+                    (n_chunks, chunk, S, xM, xM) + tail,
+                    dtype=_np_dtype(core),
                 )
-                _metrics.count("fwd.column_groups")
-            if whole_groups:
-                flat = finished.reshape((G,) + finished.shape[2:])
-                yield _whole_group_yield(groups, grp, G, flat)
-                continue
-            for gi, off0 in enumerate(grp):
-                prog_items = groups[off0]
-                items = [it for it in prog_items if it[0] is not None]
-                yield items, finished[gi // chunk, gi % chunk]
+                slab_dev = None
+                for s0 in range(0, F_pad, Fg):
+                    while len(pending) >= depth:
+                        with _metrics.stage("fwd.drain"):
+                            np.asarray(pending.popleft())
+                    # drop the previous slab BEFORE uploading the next: at
+                    # depth 1 both must never be live together
+                    # slot from a CONTINUOUS dispatch counter, not the
+                    # per-group slab index: with odd slabs-per-group a
+                    # group-local slot would reuse the buffer of the
+                    # previous group's final slab before its checksum (h2d
+                    # + compute completion) was pulled
+                    slab_dev = None  # noqa: F841 - releases device buffers
+                    if fusedfn is not None:
+                        # one dispatch: synth + sampled pass + column step
+                        with _metrics.stage(
+                            "fwd.slab_step",
+                            flops=fp_flops + step_flops,
+                            bytes_moved=coll_bytes,
+                        ):
+                            acc = fusedfn(
+                                acc,
+                                *self._sparse_pixels(s0, s0 + Fg),
+                                jnp.asarray(e0[s0 : s0 + Fg]),
+                                krows,
+                                jnp.asarray(offs0[s0 : s0 + Fg]),
+                                jnp.asarray(offs1[s0 : s0 + Fg]),
+                                so_c,
+                            )
+                    else:
+                        d = n_slab_dispatch
+                        with _metrics.stage("fwd.slab_upload") as st:
+                            bufs = None
+                            if (
+                                prefetch_fut is not None
+                                and prefetch_fut[0] == d
+                            ):
+                                # bounded wait: a wedged fill thread must
+                                # degrade to a counted miss (inline fill of
+                                # the same slot with the same bytes), never
+                                # stall the stream — host_slab is a pure
+                                # memcpy, so 120 s is ~2 orders above any
+                                # real slab
+                                try:
+                                    bufs = prefetch_fut[1].result(
+                                        timeout=120.0
+                                    )
+                                    _metrics.count(
+                                        "fwd.slab_prefetch_hits"
+                                    )
+                                except concurrent.futures.TimeoutError:
+                                    prefetch_fut[1].cancel()
+                                prefetch_fut = None
+                            if bufs is None:
+                                if use_prefetch:
+                                    _metrics.count(
+                                        "fwd.slab_prefetch_misses"
+                                    )
+                                bufs = host_slab(s0, d % n_stage)
+                            slab_dev = tuple(
+                                base._place(a) for a in bufs
+                            )
+                            st.bytes_moved = sum(
+                                int(a.nbytes) for a in slab_dev
+                            )
+                        # h2d for slab d is dispatched: the worker may now
+                        # refill buffer (d+1) % 3 — last used by slab d-2,
+                        # whose checksum the drain above already pulled
+                        if prefetch_ex is not None and d + 1 < total_dispatch:
+                            prefetch_fut = (
+                                d + 1,
+                                prefetch_ex.submit(_fill, d + 1),
+                            )
+                        with _metrics.stage(
+                            "fwd.sampled_facet_pass", flops=fp_flops
+                        ):
+                            buf = samfn(
+                                *slab_dev,
+                                jnp.asarray(e0[s0 : s0 + Fg]),
+                                krows,
+                            )
+                        with _metrics.stage(
+                            "fwd.slab_step",
+                            flops=step_flops,
+                            bytes_moved=coll_bytes,
+                        ):
+                            acc = stepfn(
+                                acc,
+                                buf,
+                                jnp.asarray(offs0[s0 : s0 + Fg]),
+                                jnp.asarray(offs1[s0 : s0 + Fg]),
+                                so_c,
+                            )
+                    n_slab_dispatch += 1
+                    pending.append(jnp.sum(acc))
+                    if logger.isEnabledFor(logging.INFO):
+                        logger.info(
+                            "  group %d/%d slab %d/%d dispatched  t=%.0fs "
+                            "rss=%.1fGiB",
+                            g0 // G + 1, -(-len(col_offs0) // G),
+                            s0 // Fg + 1, n_slabs,
+                            time.time() - t_start, _rss_gib(),
+                        )
+                # finish the whole group in one program (acc freed by the
+                # `del` below — donation can't alias it into the cropped
+                # output; the runtime orders the finish after the pending
+                # slab steps on the same buffer, and the depth-2 checksum
+                # pipeline keeps bounding live slabs)
+                with _metrics.stage("fwd.group_finish"):
+                    finished = finfn(acc, so_c, m0_c, m1_c)
+                del acc
+                grp_span.__exit__(None, None, None)
+                if _metrics.enabled():
+                    _metrics.count(
+                        "fwd.subgrids",
+                        sum(
+                            1
+                            for off0 in grp
+                            for it in groups[off0]
+                            if it[0] is not None
+                        ),
+                    )
+                    _metrics.count("fwd.column_groups")
+                    if colpass == "pallas":
+                        _metrics.count("fwd.pallas_cols", len(grp))
+                if whole_groups:
+                    flat = finished.reshape((G,) + finished.shape[2:])
+                    yield _whole_group_yield(groups, grp, G, flat)
+                    continue
+                for gi, off0 in enumerate(grp):
+                    prog_items = groups[off0]
+                    items = [it for it in prog_items if it[0] is not None]
+                    yield items, finished[gi // chunk, gi % chunk]
+        finally:
+            if prefetch_ex is not None:
+                prefetch_ex.shutdown(wait=False, cancel_futures=True)
 
     def _hbm_budget(self):
         """Per-device HBM budget in bytes (None = unlimited, e.g. CPU).
@@ -3439,7 +3688,8 @@ def grouped_col_group_for_budget(
     xM = core.xM_size
     xA = subgrid_size
     slab_b = slab_depth * facet_group * yB * yB * fsize
-    if _resolve_colpass(core, facet_group) == "einsum":
+    grouped_colpass = _resolve_colpass(core, facet_group)
+    if grouped_colpass == "einsum":
         # per column in the chunk vmap: prep1 rows, the H buffer plus its
         # wrap-extended gather copy, and one [Sb, Fg, xM, m] gather block
         Sb = min(_colpass_sblock(), S)
@@ -3450,6 +3700,18 @@ def grouped_col_group_for_budget(
                 m * core.yN_size
                 + xM * (2 * core.yN_size + m)
                 + Sb * xM * m
+            )
+        ) * dsize
+    elif grouped_colpass == "pallas":
+        # the fused kernel has NO H buffer (the prepare matmul runs
+        # inside the grid program) and its gather block is [Sb, Fg, m,
+        # m] — counted twice for the kernel's padded operand copies
+        Sb = min(_colpass_sblock(), S)
+        Sb = -(-S // -(-S // Sb))  # executed blocks are rebalanced
+        chunk_b = (
+            chunk * S * xM * xM
+            + chunk * facet_group * (
+                m * core.yN_size + 2 * Sb * m * m
             )
         ) * dsize
     else:
@@ -3517,21 +3779,31 @@ def col_group_for_budget(base, budget, n_cols, real=False,
     xA = base.config.max_subgrid_size
     xM = core.xM_size
     S = -(-core.N // xA)
-    if _resolve_colpass(core, F) == "einsum":
-        # the einsum group fn maps columns SEQUENTIALLY, so the column
-        # transients (prep1 rows, H + its wrap-extended copy, the
-        # [Sb, F, xM, m] gather block, image partials) are flat — only
-        # the sampled group buffer (with its einsum plane transients and
-        # in-program transpose) and the in-flight output stacks scale
-        # with G
+    resident_colpass = _resolve_colpass(core, F)
+    if resident_colpass in ("einsum", "pallas"):
+        # the einsum/pallas group fn maps columns SEQUENTIALLY, so the
+        # column transients (prep1 rows, gather block, image partials
+        # — plus for einsum the H buffer + its wrap-extended copy) are
+        # flat — only the sampled group buffer (with its einsum plane
+        # transients and in-program transpose) and the in-flight output
+        # stacks scale with G
         Sb = min(_colpass_sblock(), S)
         Sb = -(-S // -(-S // Sb))  # executed blocks are rebalanced
-        flat_col = (
-            F * m * core.yN_size
-            + F * xM * (2 * core.yN_size + m)
-            + Sb * F * xM * m
-            + S * xM * xM
-        ) * dsize
+        if resident_colpass == "einsum":
+            flat_col = (
+                F * m * core.yN_size
+                + F * xM * (2 * core.yN_size + m)
+                + Sb * F * xM * m
+                + S * xM * xM
+            ) * dsize
+        else:
+            # pallas: no H buffer; [Sb, F, m, m] gather block counted
+            # twice for the kernel's padded operand copies
+            flat_col = (
+                F * m * core.yN_size
+                + 2 * Sb * F * m * m
+                + S * xM * xM
+            ) * dsize
         col_b = (
             3 * F * m * yB + (2 + extra_out_stacks) * S * xA * xA
         ) * dsize

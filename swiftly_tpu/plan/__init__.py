@@ -49,6 +49,7 @@ from .model import (
     bucket_shape,
     bucket_sizes,
     hbm_budget_bytes,
+    price_colpass_candidates,
     projected_column_bytes,
     projected_request_bytes,
 )
@@ -75,6 +76,7 @@ __all__ = [
     "plan_delta",
     "plan_mesh_layout",
     "price_cache_tier",
+    "price_colpass_candidates",
     "projected_column_bytes",
     "projected_request_bytes",
 ]

@@ -103,11 +103,30 @@ def refit(history, platform=None, dispatch_s=None):
     flops_acc = {}   # stage -> [flops, seconds]
     bytes_acc = {}   # stage -> [bytes, seconds]
     n_used = 0
+    best_blocks = None  # fastest recorded pallas column-pass tile set
+    best_block_rate = 0.0
     for rec in history:
         plat = _record_platform(rec)
         if platform and plat and plat != platform:
             continue
         stages = (rec.get("telemetry") or {}).get("stages") or {}
+        # learn Pallas column-pass block sizes: of the records that ran
+        # colpass=pallas AND stamped their tiles, keep the tile set of
+        # the record with the best measured column-stage rate — this is
+        # what replaces the hardcoded SWIFTLY_COLPASS_SBLOCK=256 /
+        # bm=bn=bk=256 defaults once real history exists
+        plan = rec.get("plan") or {}
+        blocks = plan.get("colpass_blocks")
+        if plan.get("colpass") == "pallas" and isinstance(blocks, dict):
+            for stage_name in ("fwd.column_pass.pallas", "fwd.slab_step"):
+                entry = stages.get(stage_name) or {}
+                total_s = entry.get("total_s") or 0.0
+                if entry.get("flops") and total_s > 0:
+                    rate = entry["flops"] / total_s
+                    if rate > best_block_rate:
+                        best_block_rate = rate
+                        best_blocks = dict(blocks)
+                    break
         used = False
         for name, entry in stages.items():
             total_s = entry.get("total_s") or 0.0
@@ -140,6 +159,7 @@ def refit(history, platform=None, dispatch_s=None):
         source="measured",
         n_records=n_used,
         platform=platform,
+        colpass_blocks=best_blocks,
     )
     if dispatch_s is not None:
         coeffs.dispatch_s = float(dispatch_s)

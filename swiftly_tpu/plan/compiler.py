@@ -577,25 +577,59 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
     }
 
 
-def _forward_prediction(inputs):
+def _forward_prediction(inputs, coeffs=None):
     """Predicted forward grouping via the CALIBRATED streamed sizers
-    (geometry shim; the executors still bind the real choice)."""
+    (geometry shim; the executors still bind the real choice).
+
+    The colpass entry records the SAME resolution the executor will
+    make (`resolve_colpass` with the mode's in-program facet count —
+    per-shard for resident, the facet-slab size for grouped), so
+    `bench.py --smoke` can assert executed == planned; the candidates
+    list is the ranked einsum-vs-pallas pricing
+    (`price_colpass_candidates`) with each row's coefficient stage as
+    pedigree, and ``colpass_blocks`` surfaces the tile sizes a refit
+    learned from pallas history."""
     from ..parallel.streamed import (
         col_group_for_budget,
         facet_stack_bytes,
         grouped_col_group_for_budget,
     )
+    from ..utils.flops import resolve_colpass
+    from .model import price_colpass_candidates
 
     base = inputs.base()
     budget = inputs.hbm_budget
+
+    def _with_colpass(pred, facets_in_program):
+        pred["colpass"] = resolve_colpass(
+            base.core, max(1, facets_in_program)
+        )
+        if coeffs is not None:
+            pred["colpass_candidates"] = price_colpass_candidates(
+                inputs, coeffs
+            )
+            if (
+                pred["colpass"] == "pallas"
+                and coeffs.colpass_blocks is not None
+            ):
+                pred["colpass_blocks"] = dict(coeffs.colpass_blocks)
+        return pred
+
+    resident_facets = inputs.n_facets // max(1, inputs.n_devices)
     if budget is None:
-        return {"mode": "resident", "col_group": inputs.n_columns,
-                "facet_group": None}
+        return _with_colpass(
+            {"mode": "resident", "col_group": inputs.n_columns,
+             "facet_group": None},
+            resident_facets,
+        )
     if facet_stack_bytes(base, inputs.real_facets) + 3e9 <= budget:
         G = col_group_for_budget(
             base, budget, inputs.n_columns, real=inputs.real_facets
         )
-        return {"mode": "resident", "col_group": G, "facet_group": None}
+        return _with_colpass(
+            {"mode": "resident", "col_group": G, "facet_group": None},
+            resident_facets,
+        )
     Fg = 1
     slab_b = Fg * inputs.yB * inputs.yB * (
         inputs.dtype_bytes if inputs.real_facets else inputs.per_el
@@ -616,8 +650,11 @@ def _forward_prediction(inputs):
         ),
         key=lambda t: (t[0], t[1]),
     )
-    return {"mode": "grouped", "col_group": G, "facet_group": Fg,
-            "chunk": chunk, "slab_depth": depth}
+    return _with_colpass(
+        {"mode": "grouped", "col_group": G, "facet_group": Fg,
+         "chunk": chunk, "slab_depth": depth},
+        Fg,
+    )
 
 
 def compile_plan(
@@ -795,7 +832,7 @@ def compile_plan(
         spill=spill,
         serve=serve,
         mesh=mesh,
-        forward=_forward_prediction(inputs),
+        forward=_forward_prediction(inputs, coeffs),
         predicted=predicted,
         alternatives=alternatives,
         coeffs_source=coeffs.source,

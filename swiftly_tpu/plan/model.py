@@ -40,6 +40,7 @@ __all__ = [
     "bucket_shape",
     "bucket_sizes",
     "hbm_budget_bytes",
+    "price_colpass_candidates",
     "projected_column_bytes",
     "projected_request_bytes",
 ]
@@ -353,7 +354,13 @@ class StageCost:
 # (scripts/roofline.py).
 _DEFAULT_FLOPS_PER_S = {
     "fwd": 17e12,
+    # the fused Pallas column pass targets >=30% of the 65.7 TF/s
+    # f32-HIGHEST v5e peak (vs 18.1% measured for the einsum chain,
+    # roofline_32k.jsonl) — coarse anchor until autotune refits the
+    # exact stage name from a recorded pallas run
+    "fwd.column_pass.pallas": 22e12,
     "bwd.column_pass": 9e12,
+    "bwd.column_pass.pallas": 12e12,
     "bwd.sampled_fold": 9e12,
     "bwd": 9e12,
 }
@@ -398,6 +405,11 @@ class CostCoefficients:
     source: str = "default"
     n_records: int = 0
     platform: str | None = None
+    # measured-best Pallas column-pass tile sizes from artifact history
+    # ({"bm", "bn", "bk", "sblock"}, `plan.autotune.refit`) — None until
+    # a recorded pallas run exists; surfaced by `scripts/plan_explain.py
+    # --colpass` for export as SWIFTLY_COLPASS_BM/BN/BK/SBLOCK
+    colpass_blocks: dict | None = None
 
     def flops_rate(self, stage):
         for key in (stage, stage.split(".")[0]):
@@ -447,10 +459,58 @@ def price_forward(inputs, coeffs, colpass=None):
         core, inputs.n_facets, inputs.yB, inputs.n_columns * inputs.m,
         real_facets=inputs.real_facets,
     )
+    col_stage = "fwd.column_pass" + (
+        ".pallas" if colpass == "pallas" else ""
+    )
     return [
         coeffs.price("fwd.sampled_facet_pass", flops=facet_pass),
-        coeffs.price("fwd.column_pass", flops=total - facet_pass),
+        coeffs.price(col_stage, flops=total - facet_pass),
     ]
+
+
+def price_colpass_candidates(inputs, coeffs):
+    """Ranked forward column-pass candidates (einsum vs pallas).
+
+    Prices ONLY the column-pass stage of each body (the facet pass is
+    identical) with that body's exact FLOP shape and its own coefficient
+    stage name — so a refit pallas coefficient prices the pallas row
+    with measured pedigree while einsum keeps its own. Returns dicts
+    sorted fastest-first; the executor's `resolve_colpass` keeps the
+    CHOICE (defaults only rank, the compiler's measured-coefficients
+    rule), the ranking is recorded in the artifact for the operator.
+    """
+    from ..utils.flops import (
+        forward_sampled_flops,
+        sampled_facet_pass_flops,
+    )
+
+    core = inputs.base().core
+    facet_pass = sampled_facet_pass_flops(
+        core, inputs.n_facets, inputs.yB, inputs.n_columns * inputs.m,
+        real_facets=inputs.real_facets,
+    )
+    out = []
+    for colpass in ("einsum", "pallas"):
+        total = forward_sampled_flops(
+            core, n_facets=inputs.n_facets, facet_size=inputs.yB,
+            n_columns=inputs.n_columns,
+            subgrids_per_column=inputs.subgrids_per_column,
+            subgrid_size=inputs.xA, real_facets=inputs.real_facets,
+            colpass=colpass,
+        )
+        stage = "fwd.column_pass" + (
+            ".pallas" if colpass == "pallas" else ""
+        )
+        cost = coeffs.price(stage, flops=total - facet_pass)
+        out.append({
+            "colpass": colpass,
+            "coeff_stage": stage,
+            "flops": int(total - facet_pass),
+            "flops_per_s": coeffs.flops_rate(stage),
+            "predicted_wall_s": round(cost.wall_s, 4),
+        })
+    out.sort(key=lambda c: c["predicted_wall_s"])
+    return out
 
 
 def price_backward(inputs, parts, fold_group, coeffs,
@@ -493,8 +553,11 @@ def price_backward(inputs, parts, fold_group, coeffs,
     n_passes = len(parts)
     n_feeds = -(-n_passes // max(1, int(feed_group)))
     folds_per_pass = -(-inputs.n_columns // max(1, fold_group))
+    bwd_col_stage = "bwd.column_pass" + (
+        ".pallas" if colpass == "pallas" else ""
+    )
     stages = [
-        coeffs.price("bwd.column_pass", flops=col_flops,
+        coeffs.price(bwd_col_stage, flops=col_flops,
                      dispatches=n_passes * folds_per_pass),
         coeffs.price("bwd.sampled_fold", flops=fold_flops,
                      dispatches=n_passes * folds_per_pass),

@@ -50,16 +50,31 @@ def fft_flops(n: int, batch: int) -> int:
 
 
 def colpass_mode() -> str:
-    """The streamed column-pass body (einsum|fft|auto, default auto) —
-    the single parser of SWIFTLY_COLPASS, shared with
+    """The streamed column-pass body (einsum|fft|pallas|auto, default
+    auto) — the single parser of SWIFTLY_COLPASS, shared with
     `parallel.streamed` so the FLOP shape can never silently diverge
     from the executed algorithm. Read at trace/report time."""
     mode = os.environ.get("SWIFTLY_COLPASS", "auto")
-    if mode not in ("einsum", "fft", "auto"):
+    if mode not in ("einsum", "fft", "pallas", "auto"):
         raise ValueError(
-            f"SWIFTLY_COLPASS must be einsum|fft|auto, got {mode!r}"
+            f"SWIFTLY_COLPASS must be einsum|fft|pallas|auto, got {mode!r}"
         )
     return mode
+
+
+def _pallas_colpass_available(core) -> bool:
+    """The fused Pallas column pass needs the planar backend (it
+    contracts split real/imaginary planes)."""
+    return getattr(core, "backend", "") == "planar"
+
+
+def _on_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
 
 
 # Minimum stage-2 contraction depth (facets_in_program * m) for "auto"
@@ -75,11 +90,21 @@ _COLPASS_MIN_K = 0
 
 def resolve_colpass(core, n_facets_in_program: int) -> str:
     """The column-pass body a program with `n_facets_in_program` stacked
-    facets runs: the explicit SWIFTLY_COLPASS setting, or the measured
-    contraction-depth heuristic under "auto"."""
+    facets runs: the explicit SWIFTLY_COLPASS setting, or — under
+    "auto" — the fused Pallas kernel on TPU (planar backend; Mosaic
+    keeps the accumulator tile in VMEM across the whole K = F*m
+    contraction, beating the einsum chain at every measured forward
+    shape) falling back to the measured contraction-depth heuristic
+    between einsum and fft elsewhere. An explicit ``pallas`` request on
+    a non-planar backend degrades to einsum (there are no split planes
+    to feed the kernel)."""
     mode = colpass_mode()
+    if mode == "pallas":
+        return "pallas" if _pallas_colpass_available(core) else "einsum"
     if mode != "auto":
         return mode
+    if _pallas_colpass_available(core) and _on_tpu():
+        return "pallas"
     if n_facets_in_program * core.xM_yN_size >= _COLPASS_MIN_K:
         return "einsum"
     return "fft"
@@ -87,19 +112,25 @@ def resolve_colpass(core, n_facets_in_program: int) -> str:
 
 def resolve_colpass_bwd(core, n_facets_in_program: int) -> str:
     """Backward column-pass body: SWIFTLY_COLPASS_BWD if set (einsum|
-    fft), else einsum — re-measured on v5e r5 (32k round trip, fg=2):
-    41.8 s einsum vs 48.3 s fft chain. The r4 measurement had einsum
-    LOSING (80.4 vs 66.3 s), but that predated the one-shot
+    fft|pallas), else the same fused Pallas kernel the forward resolves
+    to on TPU (``reduce_f=False`` — per-facet Z products), einsum
+    elsewhere — re-measured on v5e r5 (32k round trip, fg=2): 41.8 s
+    einsum vs 48.3 s fft chain. The r4 measurement had einsum LOSING
+    (80.4 vs 66.3 s), but that predated the one-shot
     `_bwd_scatter_rows` accumulator and the rebalanced Sb blocks; with
     those, the adjoint einsums' K=xM MXU contractions beat the
     per-(subgrid, facet) fft chains despite ~2x the FLOPs."""
     mode = os.environ.get("SWIFTLY_COLPASS_BWD", "")
     if mode:
-        if mode not in ("einsum", "fft"):
+        if mode not in ("einsum", "fft", "pallas"):
             raise ValueError(
-                f"SWIFTLY_COLPASS_BWD must be einsum|fft, got {mode!r}"
+                f"SWIFTLY_COLPASS_BWD must be einsum|fft|pallas, got {mode!r}"
             )
+        if mode == "pallas" and not _pallas_colpass_available(core):
+            return "einsum"
         return mode
+    if _pallas_colpass_available(core) and _on_tpu():
+        return "pallas"
     return "einsum"
 
 
@@ -120,10 +151,18 @@ def _per_subgrid_flops(
     masks. The per-program operator build (~F*(m^3 + 2*xM*m^2) complex
     ops, <0.5% of any cover) is excluded — understating, never
     overstating, the achieved TFLOP/s.
+
+    ``colpass="pallas"``: the fused kernel runs the prepare matmul PER
+    SUBGRID (dot #1 of the triple product A0 @ Xn @ B1): per facet a
+    complex [xM, m] x [m, m] then [xM, m] x [m, xM] — so the hoisted
+    per-column H contraction of the einsum shape moves here, at the
+    gathered m-column width instead of the full yN width.
     """
     m, xM = core.xM_yN_size, core.xM_size
     if colpass == "einsum":
         return 8 * xM * xM * n_facets * m + 4 * subgrid_size**2
+    if colpass == "pallas":
+        return 8 * xM * m * (m + xM) * n_facets + 4 * subgrid_size**2
     per_facet = (
         fft_flops(m, m) + 6 * m * m  # axis 0 fft + Fn window
         + fft_flops(m, xM) + 6 * xM * m  # axis 1 fft + Fn window
@@ -138,7 +177,9 @@ def _column_prepare_flops(core, n_facets: int, colpass: str = "fft") -> int:
     """Axis-1 preparation of one column's rows: per facet, Fb window +
     ifft size yN over m rows; the einsum column pass adds its hoisted
     H = A0 @ NMBF_BF contraction ([xM, m] x [m, yN] complex per facet,
-    shared by all the column's subgrids)."""
+    shared by all the column's subgrids). The pallas body has NO hoisted
+    term — its prepare matmul fuses into the per-subgrid triple product
+    (counted in `_per_subgrid_flops`)."""
     m, yN = core.xM_yN_size, core.yN_size
     base = n_facets * (fft_flops(yN, m) + 6 * m * yN)
     if colpass == "einsum":
@@ -187,9 +228,11 @@ def bwd_column_pass_flops(
     rows): per-subgrid prepare/extract plus the per-column axis-1
     finish, for the executed body."""
     m, xM, yN = core.xM_yN_size, core.xM_size, core.yN_size
-    if colpass == "einsum":
+    if colpass in ("einsum", "pallas"):
         # two K=xM complex einsums per (subgrid, facet) plus the
-        # scatter-add into the [F, m, yN] accumulator
+        # scatter-add into the [F, m, yN] accumulator; the fused pallas
+        # body runs the same contractions (as one grid program), so the
+        # FLOP shape is identical
         per_sg = n_facets * 8 * (m * xM * xM + m * m * xM)
         per_sg += n_facets * 2 * m * yN
     else:
@@ -263,7 +306,7 @@ def forward_sampled_flops(
         * subgrids_per_column
         * _per_subgrid_flops(core, subgrid_size, n_facets, colpass)
     )
-    if colpass == "einsum":
+    if colpass in ("einsum", "pallas"):
         extra_finish = 0  # slab finish is a crop: no repeated iFFT passes
     else:
         extra_finish = (

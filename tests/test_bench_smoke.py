@@ -430,6 +430,53 @@ def test_compare_fabric_sentinels_synthetic(tmp_path):
     assert compare_main(args) == 0
 
 
+def test_compare_forward_mfu_sentinel_synthetic(tmp_path):
+    """The FORWARD MFU sentinel in scripts/bench_compare.py, exercised
+    in tier-1 on synthetic streamed-mode records (the real 64k forward
+    leg that stamps them needs a TPU): identical records stay green, a
+    doctored 2x-higher-MFU reference — wall UNCHANGED, isolating the
+    MFU leg — trips non-zero exactly like the round-trip MFU trip, and
+    the tripped verdict carries the leg's colpass pedigree so a
+    regression that is really a silent pallas->einsum fallback is
+    readable from the verdict alone."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import compare, load_records
+    from scripts.bench_compare import main as compare_main
+
+    def rec(mfu_pct=30.0, colpass="pallas"):
+        return {
+            "metric": "64k[1]-n32k-512 forward facet->subgrid "
+                      "wall-clock (warm, streamed, tpu)",
+            "value": 42.0,
+            "unit": "s",
+            "mfu_pct": mfu_pct,
+            "plan": {"colpass": colpass},
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    latest.write_text(json.dumps(rec()))
+    ref.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # doctored 2x-higher-MFU reference, wall unchanged -> trip
+    ref.write_text(json.dumps(rec(mfu_pct=60.0)))
+    assert compare_main(args) == 1
+    report = compare(load_records(latest), load_records(ref))
+    (leg,) = report["legs"]
+    assert leg["colpass"] == "pallas"
+    assert any("colpass=pallas" in p for p in leg["problems"])
+    # the pedigree also resolves from the compiled prediction when the
+    # executed stamp is absent (a leg that died before stamping)
+    fallback = rec(colpass=None)
+    del fallback["plan"]
+    fallback["plan_compiled"] = {"forward": {"colpass": "einsum"}}
+    latest.write_text(json.dumps(fallback))
+    report = compare(load_records(latest), load_records(ref))
+    (leg,) = report["legs"]
+    assert leg["colpass"] == "einsum"
+
+
 def test_bench_mesh_smoke_leg(tmp_path):
     """The `bench.py --mesh --smoke` leg (ISSUE-8 acceptance), run
     exactly as the driver would — fresh subprocess, CPU with 8 virtual

@@ -375,6 +375,51 @@ def test_plan_autotune_refit_fits_measured_rates():
     assert pooled.flops_per_s["bwd.sampled_fold"] == pytest.approx(3e12)
 
 
+def test_plan_autotune_learns_colpass_blocks_and_ranks_candidates():
+    """The colpass leg of the autotune loop: refit pools the measured
+    pallas column-stage rate under its OWN stage name, keeps the tile
+    set of the fastest pallas-stamped record, and the compiled plan's
+    forward block records the ranked einsum-vs-pallas candidate table
+    with that measured pedigree while `resolve_colpass` keeps the
+    choice (einsum on CPU)."""
+    from swiftly_tpu.plan import PlanInputs, compile_plan, refit
+
+    def rec(tf_s, blocks):
+        r = _doctored_record()
+        r["plan"] = {"colpass": "pallas", "colpass_blocks": blocks}
+        r["telemetry"]["stages"]["fwd.column_pass.pallas"] = {
+            "total_s": 10.0, "flops": tf_s * 1e12 * 10.0,
+        }
+        return r
+
+    fast_blocks = {"bm": 256, "bn": 512, "bk": 256, "sblock": 256}
+    coeffs = refit([
+        rec(10.0, {"bm": 128, "bn": 128, "bk": 128, "sblock": 64}),
+        rec(30.0, fast_blocks),
+    ])
+    assert coeffs.source == "measured"
+    assert coeffs.colpass_blocks == fast_blocks
+    assert coeffs.flops_per_s["fwd.column_pass.pallas"] == (
+        pytest.approx(20e12)  # pooled flops/seconds, not rate-averaged
+    )
+    plan = compile_plan(
+        PlanInputs.from_config("64k[1]-n32k-512", hbm_budget=16.0e9),
+        coeffs=coeffs,
+    )
+    fwd = plan.artifact_block()["forward"]
+    assert fwd["colpass"] == "einsum"  # CPU: resolver keeps the choice
+    ranked = fwd["colpass_candidates"]
+    assert [set(r["colpass"] for r in ranked)] == [{"einsum", "pallas"}]
+    pallas_row = next(
+        r for r in ranked if r["colpass"] == "pallas"
+    )
+    assert pallas_row["coeff_stage"] == "fwd.column_pass.pallas"
+    assert pallas_row["flops_per_s"] == pytest.approx(20e12)
+    assert ranked == sorted(
+        ranked, key=lambda r: r["predicted_wall_s"]
+    )
+
+
 def test_plan_autotune_changes_plan_parameter_from_history(tmp_path):
     """The acceptance loop: doctored measured artifacts -> refit ->
     `compile_plan(..., history=...)` picks a DIFFERENT fold group than

@@ -493,3 +493,84 @@ def test_backward_path_lowers_without_unusable_donations():
     if bad:
         problems["ct_fold"] = [str(w.message) for w in bad]
     assert not problems, problems
+
+
+def test_forward_path_lowers_without_unusable_donations(monkeypatch):
+    """The forward-path half of the donation sweep: the streamed column
+    group step (donated accumulator), the fused sparse slab step, and
+    the group finish all lower clean, einsum AND fused-Pallas bodies,
+    at BOTH accumulator shapes from the r5 bench tail — the
+    [1, 1, S, xM, xM, 2] streamed-partial acc and the [5, 1, S, ...]
+    grouped-finish acc whose `Some donated buffers were not usable`
+    warnings this guard retires (they predate the PR 2 un-donation fix;
+    a reappearance means a silent xM-sized copy per slab dispatch)."""
+    import jax.numpy as jnp
+
+    from conftest import unusable_donation_warnings
+    from swiftly_tpu.parallel.streamed import (
+        _column_group_finish_j,
+        _column_group_step_j,
+        _fused_sparse_slab_step_j,
+        sampled_row_indices,
+    )
+
+    monkeypatch.setenv("SWIFTLY_PALLAS_INTERPRET", "1")
+    config = SwiftlyConfig(backend="planar", **TEST_PARAMS)
+    core = config.core
+    m, xM = core.xM_yN_size, core.xM_size
+    yB, xA = TEST_PARAMS["yB_size"], TEST_PARAMS["xA_size"]
+    dt = np.dtype(core.dtype)
+    Fg = 2
+    problems = {}
+
+    # the two r5 warning shapes, scaled to the test geometry: the
+    # streamed-partial acc (one chunk) and the grouped-finish acc
+    for n_chunks, chunk, S in ((1, 1, 3), (5, 1, 2)):
+        G = n_chunks * chunk
+        col_offs = [(i * xA) % TEST_PARAMS["N"] for i in range(G)]
+        krows = jnp.asarray(sampled_row_indices(core, col_offs))
+        acc = jnp.zeros((n_chunks, chunk, S, xM, xM, 2), dt)
+        buf = jnp.zeros((Fg, G * m, yB, 2), dt)
+        foffs = jnp.zeros(Fg, jnp.int32)
+        so_c = jnp.zeros((n_chunks, chunk, S, 2), jnp.int32)
+        m0_c = jnp.ones((n_chunks, chunk, S, xA), core._Fb.dtype)
+        e0 = jnp.zeros(Fg, jnp.int32)
+        f_i = jnp.zeros(4, jnp.int32)
+        r_i = jnp.arange(4, dtype=jnp.int32)
+        c_i = jnp.arange(4, dtype=jnp.int32)
+        v = jnp.ones(4, dt)
+        for colpass in ("einsum", "pallas"):
+            tag = f"{colpass}[{n_chunks}x{chunk}x{S}]"
+            stepfn = _column_group_step_j(core, xA, chunk, colpass)
+            bad = unusable_donation_warnings(
+                lambda stepfn=stepfn: stepfn.lower(
+                    acc, buf, foffs, foffs, so_c
+                ).compile()
+            )
+            if bad:
+                problems[f"group_step.{tag}"] = [
+                    str(w.message) for w in bad
+                ]
+            fused = _fused_sparse_slab_step_j(
+                core, xA, chunk, Fg, yB, colpass
+            )
+            bad = unusable_donation_warnings(
+                lambda fused=fused: fused.lower(
+                    acc, f_i, r_i, c_i, v, e0, krows, foffs, foffs, so_c
+                ).compile()
+            )
+            if bad:
+                problems[f"fused_slab_step.{tag}"] = [
+                    str(w.message) for w in bad
+                ]
+            finfn = _column_group_finish_j(core, xA, colpass)
+            bad = unusable_donation_warnings(
+                lambda finfn=finfn: finfn.lower(
+                    acc, so_c, m0_c, m0_c
+                ).compile()
+            )
+            if bad:
+                problems[f"group_finish.{tag}"] = [
+                    str(w.message) for w in bad
+                ]
+    assert not problems, problems

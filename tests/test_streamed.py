@@ -331,6 +331,42 @@ def test_facet_slab_streaming_auto_group():
     np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
+def test_slab_stream_triple_buffer_prefetch(monkeypatch):
+    """The triple-buffered grouped stream: the background staging
+    thread (h2d(k+1) ∥ compute(k) ∥ d2h(k-1)) is bit-identical to the
+    two-buffer SWIFTLY_STREAM_PREFETCH=0 path, the plan stamps the
+    choice, and the hit counter proves the worker actually fed every
+    upload (a miss means the main thread staged inline — correct but
+    the overlap is gone)."""
+    from swiftly_tpu.obs import metrics
+
+    config, _, subgrid_configs, facet_tasks = _setup("planar")
+    monkeypatch.setenv("SWIFTLY_STREAM_PREFETCH", "0")
+    fwd_off = StreamedForward(
+        config, facet_tasks, residency="device", facet_group=2,
+        col_group=4,
+    )
+    ref = fwd_off.all_subgrids(subgrid_configs)
+    assert fwd_off.last_plan["stream_prefetch"] is False
+    monkeypatch.delenv("SWIFTLY_STREAM_PREFETCH")
+    metrics.reset()
+    metrics.enable()
+    try:
+        fwd_on = StreamedForward(
+            config, facet_tasks, residency="device", facet_group=2,
+            col_group=4,
+        )
+        out = fwd_on.all_subgrids(subgrid_configs)
+        counters = metrics.export()["counters"]
+    finally:
+        metrics.disable()
+        metrics.reset()
+    np.testing.assert_array_equal(out, ref)
+    assert fwd_on.last_plan["stream_prefetch"] is True
+    assert counters["fwd.slab_prefetch_hits"] >= 1
+    assert counters.get("fwd.slab_prefetch_misses", 0) == 0
+
+
 def test_forward_rejects_sampled_residency():
     config = SwiftlyConfig(backend="jax", **TEST_PARAMS)
     fcs = make_full_facet_cover(config)
