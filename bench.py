@@ -1261,6 +1261,20 @@ def _maybe_enable_trace():
     return path
 
 
+def _maybe_enable_recorder():
+    """Flight recorder ON by default for drills (``SWIFTLY_RECORDER=0``
+    opts out); returns the recorder module when recording, else None.
+    The ring is reset so the post-mortem window is this drill's, not a
+    previous leg's."""
+    if os.environ.get("SWIFTLY_RECORDER", "1") in ("", "0"):
+        return None
+    from swiftly_tpu.obs import recorder as orecorder
+
+    orecorder.reset()
+    orecorder.enable()
+    return orecorder
+
+
 def _zipf_workload(subgrid_configs, n_requests, seed, zipf_s=1.1):
     """A synthetic serving trace: requests zipf-distributed over
     subgrid COLUMNS (a shuffled popularity ranking, p ∝ 1/rank^s),
@@ -1662,6 +1676,17 @@ def fleet_bench(smoke_mode=False):
     exactly one resident stream copy and a >= 10x QPS-equivalent over
     the timed single-service compute baseline.
 
+    Since the control tower (PR 15) the drill also exercises the fleet
+    observability plane: every replica, the cache fabric, the
+    autoscaler and the fleet itself register as tower sources; the
+    flight recorder is ON by default (``SWIFTLY_RECORDER=0`` opts out)
+    and the kill's post-mortem bundle is stamped + dumped next to the
+    artifact; two declarative SLOs ride the supervisor tick and the
+    forced brownout ladder must open (then close) the burn-rate alert.
+    The artifact's ``fleet_telemetry`` and ``alerts`` blocks are
+    validated by `obs.validate_fleet_telemetry_artifact` /
+    `obs.validate_alerts_artifact`.
+
     Every served result is audited BIT-IDENTICAL against per-request
     `get_subgrid_task` on a fresh forward — failover, hedging and the
     cache fabric must never change an answer. The artifact's ``fleet``
@@ -1704,6 +1729,7 @@ def fleet_bench(smoke_mode=False):
     )
     enable_compilation_cache()
     trace_path = _maybe_enable_trace()
+    orecorder = _maybe_enable_recorder()
     out_path = os.environ.get("BENCH_FLEET_OUT", "BENCH_fleet.json")
     if smoke_mode:
         os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
@@ -1790,6 +1816,23 @@ def fleet_bench(smoke_mode=False):
         column_bytes=fleet_plan.serve.column_bytes,
         fabric=fabric, drain_timeout_s=20.0,
     )
+    # declarative SLOs on the control tower: the forced brownout ladder
+    # in the overload phase must OPEN the burn-rate alert (fast AND
+    # slow windows burning) and the step-down must CLOSE it — the alert
+    # lifecycle is a drill outcome, asserted under --smoke. The shed
+    # SLO stays quiet (the drill sheds a dozen of hundreds): one alert
+    # that fires and one that doesn't is the schema's smoke test.
+    from swiftly_tpu.obs import SLO
+
+    fleet.tower.set_slos([
+        # windows sized to the drill: the ladder holds rung >= 1 for
+        # brownout_escalate_s (0.1s) before rung 2, so a 0.2s slow
+        # window is >= half-breached by the time rung 2 lands
+        SLO("brownout_engaged", "fleet.brownout_level", 0.5,
+            direction="above", fast_s=0.05, slow_s=0.2, burn=0.4),
+        SLO("shed_storm", "fleet.shed_rate", 0.5,
+            direction="above", fast_s=0.5, slow_s=2.0, burn=0.5),
+    ])
 
     # one shared workload per phase (same seed -> identical request
     # multiset), so the before/during/after p99 windows are comparable
@@ -1891,6 +1934,15 @@ def fleet_bench(smoke_mode=False):
             and time.time() < deadline
         ):
             time.sleep(0.005)
+    # the black box earns its keep HERE: snapshot the recorder window
+    # while the kill's event tail (fault injection, replica death,
+    # lease revocation, breaker trip, failovers) is the recent past
+    kill_post_mortem = (
+        orecorder.post_mortem(
+            "WorkerKilled", reason=f"replica {victim} killed mid-burst"
+        )
+        if orecorder is not None else None
+    )
 
     # -- phase 3: restore + recovery window -------------------------------
     if victim is not None:
@@ -2012,6 +2064,10 @@ def fleet_bench(smoke_mode=False):
     fleet.drain(timeout=60.0)
     wall = time.time() - t0
     stats = fleet.stats(wall_s=wall)
+    # tower blocks BEFORE stop(): the replica sources are still
+    # registered, so the fleet totals cover every serving source
+    fleet_telemetry = fleet.tower.fleet_telemetry()
+    alerts_block = fleet.tower.alerts_block()
     fleet.stop()
     fleet_span.__exit__(None, None, None)
 
@@ -2152,11 +2208,24 @@ def fleet_bench(smoke_mode=False):
             "qps_equivalent_ratio": round(qps_ratio, 2),
         },
         "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
+        "fleet_telemetry": fleet_telemetry,
+        "alerts": alerts_block,
         "n_subgrids_cover": len(subgrid_configs),
         "manifest": run_manifest(
             params={"config": name, "mode": "fleet", **params},
         ),
     }
+    if orecorder is not None:
+        pm_path = os.path.splitext(out_path)[0] + "_postmortem.jsonl"
+        orecorder.dump(
+            pm_path, "WorkerKilled",
+            reason=f"replica {victim} killed mid-burst",
+        )
+        record["post_mortem"] = dict(
+            kill_post_mortem
+            or orecorder.post_mortem("drill_complete")
+        )
+        record["post_mortem"]["dump_path"] = pm_path
     if metrics.enabled():
         record["telemetry"] = metrics.export()
     if trace_path:
@@ -2170,7 +2239,14 @@ def fleet_bench(smoke_mode=False):
         otrace.save(trace_path)
         otrace.disable()
 
+    from swiftly_tpu.obs import (
+        validate_alerts_artifact,
+        validate_fleet_telemetry_artifact,
+    )
+
     problems = validate_fleet_artifact(record)
+    problems.extend(validate_fleet_telemetry_artifact(record))
+    problems.extend(validate_alerts_artifact(record))
     if smoke_mode:
         # drill outcomes: the schema passing is not proof the fleet
         # actually healed
@@ -2274,6 +2350,49 @@ def fleet_bench(smoke_mode=False):
                 f"p99 not held through elastic churn: {p99_elastic}ms "
                 f"vs {p99_before}ms before (> 1.5x)"
             )
+        # control-tower drill outcomes: the forced ladder must have
+        # burned the brownout SLO open and the step-down closed it,
+        # and the kill's post-mortem must tell the failure story
+        if alerts_block["opened"] < 1:
+            problems.append(
+                "SLO burn-rate alert never opened under the forced "
+                f"brownout ladder: {alerts_block}"
+            )
+        if alerts_block["open"]:
+            problems.append(
+                f"alerts still open at drill end: {alerts_block['open']}"
+            )
+        if not any(
+            e["slo"] == "brownout_engaged" for e in alerts_block["events"]
+        ):
+            problems.append(
+                "the brownout_engaged SLO never appears in the alert "
+                f"event log: {alerts_block['events']}"
+            )
+        if orecorder is not None:
+            pm_kinds = record["post_mortem"]["by_kind"]
+            pm_names = [
+                e["name"] for e in record["post_mortem"]["events"]
+            ]
+            if not any(
+                n.startswith("fault.injected.fleet.replica.kill")
+                for n in pm_names
+            ):
+                problems.append(
+                    "kill post-mortem tail missing the injected "
+                    f"fleet.replica.kill fault: {pm_names}"
+                )
+            if "fleet.replica_death" not in pm_names:
+                problems.append(
+                    "kill post-mortem tail missing the replica death "
+                    f"event: {pm_names}"
+                )
+            for kind in ("fault", "fleet", "lease"):
+                if not pm_kinds.get(kind):
+                    problems.append(
+                        f"kill post-mortem recorded no {kind!r} "
+                        f"events: {pm_kinds}"
+                    )
     with open(out_path, "w") as fh:
         json.dump(record, fh, indent=2)
     if smoke_mode:
@@ -2295,6 +2414,12 @@ def fleet_bench(smoke_mode=False):
                     "scale_outs": stats["scale_outs"],
                     "drains": stats["drains"],
                     "qps_equivalent_ratio": round(qps_ratio, 2),
+                    "alerts_opened": alerts_block["opened"],
+                    "alerts_open": len(alerts_block["open"]),
+                    "recorder_events": (
+                        record["post_mortem"]["n_events"]
+                        if orecorder is not None else 0
+                    ),
                     "problems": problems,
                 }
             ),
@@ -3465,17 +3590,33 @@ def run_chaos_drill(config_name, fault_plan=None, fold_group=2,
         spill_chaos = SpillCache()
         resumes = 0
         got = None
+        from swiftly_tpu.obs import recorder as orecorder
+
         with faults.active(fault_plan):
             try:
                 got = run_passes(spill_chaos, autosave=True)
             except WorkerKilled as exc:
                 log.warning("chaos drill: %s; resuming from checkpoint",
                             exc)
+                orecorder.record(
+                    "drill", "chaos.worker_killed", str(exc)
+                )
                 resumes += 1
                 got = run_passes(
                     spill_chaos, autosave=True, resume=True
                 )
         chaos_s = time.time() - t0
+        # snapshot the black box while the kill -> fallback -> resume
+        # story is the recent past (the drill stamps it; --smoke
+        # asserts the tail actually tells it)
+        post_mortem = (
+            orecorder.post_mortem(
+                "WorkerKilled",
+                reason=f"bwd.feed kill at call {kill_at}, "
+                       f"resumed {resumes}x",
+            )
+            if orecorder.enabled() else None
+        )
 
         bit_identical = bool(
             got.shape == ref.shape and np.array_equal(got, ref)
@@ -3507,7 +3648,7 @@ def run_chaos_drill(config_name, fault_plan=None, fold_group=2,
             "kill_at_call": kill_at,
             "bit_identical": bit_identical,
         }
-        return {
+        record = {
             "metric": f"chaos-drill {config_name}",
             "value": round(chaos_s, 2),
             "unit": "s",
@@ -3522,6 +3663,9 @@ def run_chaos_drill(config_name, fault_plan=None, fold_group=2,
             "resilience": resilience,
             "spill": spill_chaos.stats(),
         }
+        if post_mortem is not None:
+            record["post_mortem"] = post_mortem
+        return record
     finally:
         faults.uninstall()
         shutil.rmtree(work_dir, ignore_errors=True)
@@ -3551,6 +3695,7 @@ def chaos(smoke_mode=False):
     )
     enable_compilation_cache()
     trace_path = _maybe_enable_trace()
+    orecorder = _maybe_enable_recorder()
     out_path = os.environ.get("BENCH_CHAOS_OUT", "BENCH_chaos.json")
     metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
     name = os.environ.get(
@@ -3599,6 +3744,37 @@ def chaos(smoke_mode=False):
             f"degradation trail missing the checkpoint fallback: "
             f"{res['degradations']}"
         )
+    if orecorder is not None:
+        pm_path = os.path.splitext(out_path)[0] + "_postmortem.jsonl"
+        orecorder.dump(
+            pm_path, "WorkerKilled",
+            reason=record.get("post_mortem", {}).get("reason"),
+        )
+        if "post_mortem" in record:
+            record["post_mortem"]["dump_path"] = pm_path
+        # the post-mortem must TELL the drill's story: the injected
+        # kill and the degradation ladder it forced
+        pm_names = [
+            e["name"]
+            for e in record.get("post_mortem", {}).get("events", [])
+        ]
+        if not any(
+            n.startswith("fault.injected.bwd.feed") for n in pm_names
+        ):
+            problems.append(
+                "chaos post-mortem tail missing the injected bwd.feed "
+                f"kill: {pm_names}"
+            )
+        if not any(n.startswith("degrade.") for n in pm_names):
+            problems.append(
+                "chaos post-mortem tail missing the degradation "
+                f"ladder steps: {pm_names}"
+            )
+        if "chaos.worker_killed" not in pm_names:
+            problems.append(
+                "chaos post-mortem tail missing the drill's "
+                f"worker-killed marker: {pm_names}"
+            )
     import json as _json
 
     with open(out_path, "w") as fh:
@@ -3613,6 +3789,9 @@ def chaos(smoke_mode=False):
                 "bit_identical": res["bit_identical"],
                 "faults_injected": res["faults_injected_total"],
                 "resume_count": res["resume_count"],
+                "recorder_events": (
+                    record.get("post_mortem", {}).get("n_events", 0)
+                ),
                 "problems": problems,
             }
         ),
@@ -3824,6 +4003,17 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
                 parts.append(np.asarray(bwd.finish()))
         got = np.concatenate(parts, axis=0)
         chaos_s = time.time() - t0
+        # snapshot the black box while the shard loss -> re-plan ->
+        # migrate -> resume ladder is the recent past
+        from swiftly_tpu.obs import recorder as orecorder
+
+        post_mortem = (
+            orecorder.post_mortem(
+                "ShardLostError",
+                reason=f"mesh.shard_loss at call {kill_at}",
+            )
+            if orecorder.enabled() else None
+        )
 
         bit_identical = bool(
             got.shape == ref.shape and np.array_equal(got, ref)
@@ -3904,7 +4094,7 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
             "recovery": recovery_block,
         }
         platform = jax.devices()[0].platform
-        return {
+        record = {
             "metric": f"{config_name} mesh chaos drill wall-clock "
                       f"({n_shards} shards kill one mid-stream, "
                       f"planar f32, mesh-chaos, {platform})",
@@ -3924,6 +4114,9 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
                 measured_wall_s=chaos_s
             ),
         }
+        if post_mortem is not None:
+            record["post_mortem"] = post_mortem
+        return record
     finally:
         faults.uninstall()
         shutil.rmtree(work_dir, ignore_errors=True)
@@ -3977,6 +4170,7 @@ def mesh_chaos(smoke_mode=False):
 
     enable_compilation_cache()
     trace_path = _maybe_enable_trace()
+    orecorder = _maybe_enable_recorder()
     out_path = os.environ.get(
         "BENCH_MESH_CHAOS_OUT", "BENCH_mesh_chaos.json"
     )
@@ -4039,6 +4233,35 @@ def mesh_chaos(smoke_mode=False):
         problems.append(
             f"no transient fault was retried+recovered: {res}"
         )
+    if orecorder is not None:
+        pm_path = os.path.splitext(out_path)[0] + "_postmortem.jsonl"
+        orecorder.dump(
+            pm_path, "ShardLostError",
+            reason=record.get("post_mortem", {}).get("reason"),
+        )
+        if "post_mortem" in record:
+            record["post_mortem"]["dump_path"] = pm_path
+        # the post-mortem must tell the elastic ladder's story: the
+        # injected shard loss and every recovery rung behind it
+        pm_names = [
+            e["name"]
+            for e in record.get("post_mortem", {}).get("events", [])
+        ]
+        if not any(
+            n.startswith("fault.injected.mesh.shard_loss")
+            for n in pm_names
+        ):
+            problems.append(
+                "mesh post-mortem tail missing the injected "
+                f"shard loss: {pm_names}"
+            )
+        for step in ("mesh.recovery.detected", "mesh.recovery.replanned",
+                     "mesh.recovery.resumed"):
+            if step not in pm_names:
+                problems.append(
+                    f"mesh post-mortem tail missing the {step} "
+                    f"ladder step: {pm_names}"
+                )
     import json as _json
 
     with open(out_path, "w") as fh:
@@ -4056,6 +4279,9 @@ def mesh_chaos(smoke_mode=False):
                 ),
                 "recovery_overhead": rec["recovery_overhead"],
                 "stalls_detected": rec["watchdog"]["stalls_detected"],
+                "recorder_events": (
+                    record.get("post_mortem", {}).get("n_events", 0)
+                ),
                 "problems": problems,
             }
         ),

@@ -111,6 +111,93 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+# Every sentinel this script enforces, in one table: metric name,
+# which direction is good, what trips it, and the PR that introduced
+# it. ``--list-sentinels`` prints this — the docstring above narrates
+# the same facts but a drill operator wants the table, not the essay.
+SENTINELS = [
+    {
+        "name": "wall",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 5,
+        "applies_to": "every leg",
+    },
+    {
+        "name": "mfu_pct",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 5,
+        "applies_to": "legs stamping MFU (round-trip since PR 9, "
+                      "forward streamed since PR 14; verdict carries "
+                      "colpass pedigree)",
+    },
+    {
+        "name": "p99_ms",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 6,
+        "applies_to": "serve/fleet legs",
+    },
+    {
+        "name": "throughput_rps",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 6,
+        "applies_to": "serve/fleet legs",
+    },
+    {
+        "name": "plan_compiled (mispricing)",
+        "direction": "ratio in [1/x, x]",
+        "threshold": "--plan-threshold (default 2.0x) predicted vs "
+                     "measured; calibrated coeffs only",
+        "source_pr": 7,
+        "applies_to": "legs with a plan_compiled block",
+    },
+    {
+        "name": "mesh.scaling_efficiency",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 8,
+        "applies_to": "mesh legs",
+    },
+    {
+        "name": "delta.speedup_vs_full",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 11,
+        "applies_to": "incremental-update (--delta) legs",
+    },
+    {
+        "name": "rms_vs_dft_oracle",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 11,
+        "applies_to": "precision legs",
+    },
+    {
+        "name": "mesh.recovery.recovery_overhead",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 12,
+        "applies_to": "mesh chaos legs",
+    },
+    {
+        "name": "cache.hit_ratio",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 13,
+        "applies_to": "fleet legs with the shared cache fabric",
+    },
+    {
+        "name": "fleet.stream_copies",
+        "direction": "lower",
+        "threshold": "ANY increase over best reference",
+        "source_pr": 13,
+        "applies_to": "fleet legs with the shared cache fabric",
+    },
+]
+
 # metric strings look like
 #   "32k[1]-n16k-512 forward facet->subgrid wall-clock (842 subgrids,
 #    planar f32, roundtrip-streamed, tpu)"
@@ -460,7 +547,13 @@ def main(argv=None):
         description="diff a BENCH artifact against baseline artifacts"
     )
     parser.add_argument(
-        "latest", help="the artifact under test (JSON or JSONL)"
+        "latest", nargs="?", default=None,
+        help="the artifact under test (JSON or JSONL)",
+    )
+    parser.add_argument(
+        "--list-sentinels", action="store_true", dest="list_sentinels",
+        help="print the full sentinel table (name, direction, "
+             "threshold, source PR) and exit",
     )
     parser.add_argument(
         "--against", action="append", default=[],
@@ -487,6 +580,21 @@ def main(argv=None):
              "(default: report only)",
     )
     args = parser.parse_args(argv)
+
+    if args.list_sentinels:
+        if args.as_json:
+            print(json.dumps({"sentinels": SENTINELS}, indent=2))
+            return 0
+        print(f"{len(SENTINELS)} sentinel(s):")
+        for s in SENTINELS:
+            print(
+                f"  {s['name']:<32} {s['direction']:<18} PR {s['source_pr']}"
+            )
+            print(f"    trips: {s['threshold']}")
+            print(f"    on:    {s['applies_to']}")
+        return 0
+    if args.latest is None:
+        parser.error("latest artifact required unless --list-sentinels")
 
     try:
         latest = load_records(args.latest)

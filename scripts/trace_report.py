@@ -15,8 +15,14 @@ leg wall within 5% by construction on a healthy trace (asserted by
 ``bench.py --smoke --trace``); a larger gap means spans leaked or the
 tree is torn.
 
+``--by-source`` groups the attribution per Perfetto track instead —
+one row per named fleet source (``replica-N``, ``fleet-supervisor``,
+request journeys), the view the control tower's track naming exists
+for.
+
 Usage:
     python scripts/trace_report.py BENCH_trace.json [--top 10] [--json]
+        [--by-source]
 """
 
 import argparse
@@ -51,6 +57,11 @@ def main(argv=None):
         "--json", action="store_true", dest="as_json",
         help="emit the summary as one JSON object (for tooling/tests)",
     )
+    parser.add_argument(
+        "--by-source", action="store_true", dest="by_source",
+        help="group attribution per Perfetto track (replica-N, "
+        "fleet-supervisor, request journeys) instead of fleet-wide",
+    )
     args = parser.parse_args(argv)
 
     trace = report.load_trace(args.trace)
@@ -61,6 +72,24 @@ def main(argv=None):
             + "; ".join(problems[:5]),
             file=sys.stderr,
         )
+    if args.by_source:
+        rows = report.by_source(trace, top_k=args.top)
+        if args.as_json:
+            print(json.dumps({"by_source": rows}))
+            return 0 if not problems else 1
+        print(f"trace: {args.trace} — {len(rows)} source track(s)")
+        for row in rows:
+            print(
+                f"\n{row['label']} (tid {row['tid']}): "
+                f"{row['spans']} span(s), {row['events']} event(s), "
+                f"self {row['self_s']:.4f}s"
+            )
+            for st in row["top"]:
+                print(
+                    f"  {st['name']:<28} x{st['count']:<6} "
+                    f"self {st['self_s']:>10.4f}s"
+                )
+        return 0 if not problems else 1
     summary = report.summarize_trace(trace, top_k=args.top)
     if args.as_json:
         print(json.dumps(summary))

@@ -38,6 +38,7 @@ import numpy as np
 
 from ..api import LRUCache
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 from ..parallel.streamed import CachedColumnFeed
 
@@ -236,6 +237,8 @@ class SharedStreamTier:
         _trace.instant("cache.roll", cat="cache",
                        stream_version=self.stream_version,
                        mode=mode)
+        _recorder.record("cache", "cache.roll",
+                         f"v{self.stream_version} mode={mode}")
         if _metrics.enabled():
             _metrics.count("cache.rolls")
         return self.stream_version

@@ -47,6 +47,7 @@ import logging
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 from ..parallel.mesh import make_facet_mesh, mesh_size
 from ..resilience import degrade as _degrade
@@ -114,6 +115,8 @@ def recover_engines(forward, backward, plan_inputs=None,
         "mesh.recovery.detected", cat="fault",
         shards=before, lost_shard=lost_shard,
     )
+    _recorder.record("mesh", "mesh.recovery.detected",
+                     f"{before} shard(s), lost {lost_shard}")
     mesh, lost = survivor_mesh(forward.mesh, lost_shard)
     layout = None
     if plan_inputs is not None:
@@ -128,6 +131,8 @@ def recover_engines(forward, backward, plan_inputs=None,
         shards=mesh_size(mesh),
         facet_shards=(layout.facet_shards if layout else None),
     )
+    _recorder.record("mesh", "mesh.recovery.replanned",
+                     f"{before} -> {mesh_size(mesh)} shard(s)")
     new_fwd = forward.rebuild_on(mesh, layout)
     new_bwd = backward.rebuild_on(mesh, layout)
     processed = ()
@@ -148,6 +153,9 @@ def recover_engines(forward, backward, plan_inputs=None,
         shards=mesh_size(mesh), skipped=len(processed),
         recovery_wall_s=wall,
     )
+    _recorder.record("mesh", "mesh.recovery.resumed",
+                     f"{len(processed)} subgrid(s) migrated, "
+                     f"{wall:.3f}s")
     logger.warning(
         "mesh recovery: shard %s lost; re-planned %d -> %d shard(s) "
         "in %.3fs (%d subgrid(s) already folded)",

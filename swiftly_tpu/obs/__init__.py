@@ -28,6 +28,17 @@ perf artifact this repo emits is *measured, attributed and auditable*:
 * ``obs.heartbeat`` — progress reporting for hour-scale runs
   (units/s, ETA) and incremental partial-artifact flushing so a killed
   run still leaves its finished legs on disk.
+* ``obs.recorder`` — the always-on flight recorder: a bounded
+  lock-light ring of fleet events (faults, ladder steps, breaker/lease
+  flips, autoscale decisions, cache rolls) kept even with tracing OFF,
+  dumped as a post-mortem bundle on `WorkerKilled`/`ShardLostError`/
+  forced drain/SLO breach. ``SWIFTLY_RECORDER=1`` /
+  ``SWIFTLY_RECORDER_SECONDS``.
+* ``obs.tower`` — the fleet control tower: named telemetry sources
+  merged into one ``fleet_telemetry`` block (per-replica breakdowns +
+  fleet totals), windowed signals shared by the brownout ladder and
+  autoscaler, and declarative SLOs evaluated with multi-window
+  burn-rate rules into an ``alerts`` block.
 
 Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
 ``SWIFTLY_METRICS_JSONL``) / ``SWIFTLY_TRACE=1`` (Chrome JSON in
@@ -36,7 +47,7 @@ Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
 docs/observability.md.
 """
 
-from . import metrics, report, trace
+from . import metrics, recorder, report, tower, trace
 from .heartbeat import Heartbeat, PartialArtifactWriter
 from .manifest import (
     run_manifest,
@@ -49,17 +60,29 @@ from .manifest import (
     validate_serve_artifact,
 )
 from .report import summarize_trace, validate_trace_artifact
+from .tower import (
+    SLO,
+    ControlTower,
+    validate_alerts_artifact,
+    validate_fleet_telemetry_artifact,
+)
 
 __all__ = [
+    "ControlTower",
     "Heartbeat",
     "PartialArtifactWriter",
+    "SLO",
     "metrics",
+    "recorder",
     "report",
     "run_manifest",
+    "tower",
     "summarize_trace",
     "trace",
+    "validate_alerts_artifact",
     "validate_artifact",
     "validate_delta_artifact",
+    "validate_fleet_telemetry_artifact",
     "validate_fleet_artifact",
     "validate_mesh_artifact",
     "validate_plan_artifact",

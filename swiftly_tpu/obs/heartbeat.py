@@ -40,12 +40,13 @@ class Heartbeat:
     """
 
     def __init__(self, total, label="units", interval_s=30.0,
-                 log=None):
+                 log=None, tower=None):
         self.total = int(total)
         self.label = label
         self.interval_s = float(interval_s)
         self.done = 0
         self._log = log or logger
+        self._tower = tower
         self._t0 = time.time()
         self._last_emit = 0.0  # first update() emits immediately
 
@@ -69,6 +70,11 @@ class Heartbeat:
         rate = self.done / elapsed
         remaining = max(self.total - self.done, 0)
         eta_s = remaining / rate if rate > 0 else float("inf")
+        if self._tower is not None:
+            # fleet state rides along on every beat: replica count,
+            # open alerts, queue depth, brownout rung — already-sampled
+            # tower state, no source calls on this path
+            fields = {**self._tower.heartbeat_fields(), **fields}
         self._log.info(
             "%s %d/%d (%.2f/s, elapsed %.0fs%s)",
             self.label, self.done, self.total, rate, elapsed,

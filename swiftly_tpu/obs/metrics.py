@@ -35,6 +35,7 @@ import os
 import threading
 import time
 
+from . import recorder as _recorder
 from . import trace as _trace
 
 __all__ = [
@@ -165,6 +166,9 @@ class _Stage:
         if self._reg.enabled:
             self._reg._record_stage(self.name, wall, self.flops,
                                     self.bytes_moved)
+        rec = _recorder._RECORDER
+        if rec.enabled:
+            rec.record("stage", self.name, round(wall, 6))
         return False
 
 
@@ -243,10 +247,13 @@ class MetricsRegistry:
         ``flops``/``bytes_moved`` are the dispatch's analytic compute
         and data-movement attribution (accumulated into the stage).
         Disabled this returns a shared no-op object immediately —
-        unless the span tracer is on, in which case the stage runs as
-        a trace-only span (no registry state).
+        unless the span tracer is on (the stage runs as a trace-only
+        span, no registry state) or the flight recorder is on (a
+        recorder-only timer appends one ring event).
         """
         if not self.enabled and not _trace._TRACER.enabled:
+            if _recorder._RECORDER.enabled:
+                return _recorder._RecorderStage(name)
             return _NULL_STAGE
         return _Stage(self, name, flops, bytes_moved)
 
@@ -425,8 +432,10 @@ def reset():
 
 
 def stage(name, flops=0, bytes_moved=0):
-    # keep the disabled path shallow: two attribute checks, shared no-op
+    # keep the disabled path shallow: three attribute checks, shared no-op
     if not _REGISTRY.enabled and not _trace._TRACER.enabled:
+        if _recorder._RECORDER.enabled:
+            return _recorder._RecorderStage(name)
         return _NULL_STAGE
     return _Stage(_REGISTRY, name, flops, bytes_moved)
 

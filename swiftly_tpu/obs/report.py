@@ -29,6 +29,7 @@ import json
 __all__ = [
     "TRACE_ARTIFACT_FIELDS",
     "build_tree",
+    "by_source",
     "critical_path",
     "journey_stats",
     "load_trace",
@@ -255,6 +256,63 @@ def summarize_trace(trace, root_id=None, top_k=5):
     if journeys:
         out["journeys"] = journeys
     return out
+
+
+def by_source(trace, top_k=5):
+    """Per-source attribution: spans and instants grouped by Perfetto
+    track (tid), each labelled with its ``"M"`` thread-name metadata —
+    the fleet tracks `trace.name_track` registered (``replica-N``,
+    ``fleet-supervisor``) plus the synthetic journey rows. Returns
+    rows sorted by self time, busiest source first."""
+    labels = {}
+    for e in trace.get("traceEvents", ()):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            labels[e.get("tid")] = (e.get("args") or {}).get("name")
+    spans = build_tree(trace)
+    selfs = self_times(spans)
+    groups = {}
+
+    def group(tid):
+        return groups.setdefault(tid, {
+            "label": labels.get(tid) or f"tid {tid}",
+            "spans": 0, "events": 0, "wall_s": 0.0, "self_s": 0.0,
+            "stages": {},
+        })
+
+    for sid, s in spans.items():
+        g = group(s["tid"])
+        g["spans"] += 1
+        g["wall_s"] += s["dur_s"]
+        g["self_s"] += selfs[sid]
+        st = g["stages"].setdefault(
+            s["name"], {"count": 0, "self_s": 0.0}
+        )
+        st["count"] += 1
+        st["self_s"] += selfs[sid]
+    for e in trace.get("traceEvents", ()):
+        if e.get("ph") in ("i", "I"):
+            group(e.get("tid"))["events"] += 1
+    rows = []
+    for tid, g in sorted(
+        groups.items(), key=lambda kv: -kv[1]["self_s"]
+    ):
+        top = sorted(
+            g["stages"].items(), key=lambda kv: -kv[1]["self_s"]
+        )[:top_k]
+        rows.append({
+            "tid": tid,
+            "label": g["label"],
+            "spans": g["spans"],
+            "events": g["events"],
+            "wall_s": round(g["wall_s"], 6),
+            "self_s": round(g["self_s"], 6),
+            "top": [
+                {"name": n, "count": v["count"],
+                 "self_s": round(v["self_s"], 6)}
+                for n, v in top
+            ],
+        })
+    return rows
 
 
 # The block every ``--trace`` BENCH artifact must carry — the timeline's

@@ -66,6 +66,7 @@ __all__ = [
     "export",
     "get_tracer",
     "instant",
+    "name_track",
     "reset",
     "save",
     "set_hbm_gauge",
@@ -154,6 +155,7 @@ class Tracer:
         self.path = None
         self._spans = []   # finished spans, completion order
         self._events = []  # instant events
+        self._track_names = {}  # tid -> label ("M" metadata + --by-source)
         self._t0 = time.perf_counter()
         self._t_epoch = time.time()
         self._hbm_sampler = None
@@ -197,6 +199,7 @@ class Tracer:
         with self._lock:
             self._spans = []
             self._events = []
+            self._track_names = {}
             self._t0 = time.perf_counter()
             self._t_epoch = time.time()
             self._hbm_gauge = None
@@ -216,6 +219,21 @@ class Tracer:
         if not self.enabled:
             return _NULL_SPAN
         return _Span(self, name, cat, args)
+
+    def name_track(self, tid, label):
+        """Label one Perfetto track (thread row): fleet sources name
+        their own tid (``replica-3``, ``fleet-supervisor``) so the
+        exported timeline reads per-source and ``trace_report.py
+        --by-source`` can group attribution the same way."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._track_names[int(tid)] = str(label)
+
+    def track_names(self):
+        """``{tid: label}`` of explicitly named tracks."""
+        with self._lock:
+            return dict(self._track_names)
 
     def instant(self, name, cat="event", **args):
         """One timestamped point event (fault injections, degradation
@@ -320,8 +338,8 @@ class Tracer:
             spans = list(self._spans)
             events = list(self._events)
             t_epoch = self._t_epoch
+            named_tids = dict(self._track_names)
         out = []
-        named_tids = {}
         for s in spans:
             args = dict(s["args"])
             args["span_id"] = s["id"]
@@ -456,6 +474,10 @@ def span(name, cat="host", **args):
 
 def instant(name, cat="event", **args):
     _TRACER.instant(name, cat=cat, **args)
+
+
+def name_track(tid, label):
+    _TRACER.name_track(tid, label)
 
 
 def add_span(name, t0, t1, cat="host", tid=None, parent=0, **args):

@@ -39,6 +39,7 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 from .retry import backoff_delay
 
@@ -114,6 +115,9 @@ class CircuitBreaker:
             _metrics.count(f"breaker.{self.name}.to_{to}")
         _trace.instant("breaker.transition", cat="breaker",
                        breaker=self.name, frm=frm, to=to, reason=reason)
+        _recorder.record("breaker",
+                         f"breaker.{self.name or 'default'}.{frm}->{to}",
+                         reason)
 
     # -- the gate ------------------------------------------------------------
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import threading
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 
 __all__ = ["events", "record", "reset"]
@@ -45,6 +46,8 @@ def record(site, action, detail=None):
     # WHERE the run degraded, not just that it did
     _trace.instant(f"degrade.{site}.{action}", cat="degrade",
                    site=site, action=action)
+    _recorder.record("degrade", f"degrade.{site}.{action}",
+                     str(detail) if detail is not None else None)
     with _lock:
         if len(_events) >= _MAX_EVENTS:
             _dropped += 1

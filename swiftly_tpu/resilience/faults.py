@@ -69,6 +69,7 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 
 __all__ = [
@@ -260,6 +261,10 @@ class FaultPlan:
         # injection is an instant event on the recorded timeline
         _trace.instant("fault.injected", cat="fault", site=site,
                        fault_kind=hit.kind, call=n)
+        # the black box keeps injections even with tracing off — a
+        # post-mortem must show what was fired before the trigger raise
+        _recorder.record("fault", f"fault.injected.{site}",
+                         f"{hit.kind} call {n}")
         if hit.kind == "ioerror":
             raise FaultError(f"injected IOError at {site} (call {n})")
         if hit.kind == "oom":

@@ -23,6 +23,14 @@ consecutive signals, and ``cooldown_s`` separates consecutive actions —
 one zipf burst cannot flap the fleet. Drive it by attaching to the
 fleet (``fleet.autoscaler = scaler`` — the supervisor tick evaluates
 it) or call `tick` directly with an injected clock (tests, bench).
+
+PR 15: the signals now come from the fleet's `obs.tower.ControlTower`.
+Attached to a fleet, the supervisor tick passes the tower's per-tick
+sample into `tick(signals=...)` — the SAME values the brownout ladder
+read that tick; a direct `tick()` call samples the tower on demand (or
+falls back to the raw fleet methods when no tower exists). Decisions
+are bit-identical either way — the tower sample IS
+``fleet.queue_share()``/``queued_depth()`` read once.
 """
 
 from __future__ import annotations
@@ -31,6 +39,7 @@ import logging
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 
 __all__ = ["FleetAutoscaler"]
@@ -86,18 +95,39 @@ class FleetAutoscaler:
         self.events = []
         self._counts = {"ticks": 0, "scale_outs": 0, "drains": 0,
                         "held_by_band": 0, "held_by_cooldown": 0}
+        tower = getattr(fleet, "tower", None)
+        if tower is not None:
+            tower.register_source(
+                "autoscaler",
+                lambda: {"counters": dict(self._counts),
+                         "band": [self.min_replicas, self.max_replicas]},
+                kind="controller",
+            )
 
     # -- policy --------------------------------------------------------------
 
-    def tick(self, now=None):
+    def tick(self, now=None, signals=None):
         """One policy evaluation; returns ``"scale_out"``, ``"drain"``
         or None. Safe to call from the fleet supervisor (scale-in is
         initiated, not awaited — `ServeFleet.begin_drain` retires the
-        replica on a later supervision pass once its work is gone)."""
+        replica on a later supervision pass once its work is gone).
+
+        ``signals`` is the tower's per-tick sample (the supervisor
+        passes the one it already took this tick); absent, the fleet's
+        tower is sampled on demand, and a tower-less fleet falls back
+        to reading the raw signals directly."""
         now = self._clock() if now is None else now
         self._counts["ticks"] += 1
-        share = self.fleet.queue_share()
-        depth = self.fleet.queued_depth()
+        if signals is None:
+            tower = getattr(self.fleet, "tower", None)
+            if tower is not None:
+                signals = tower.sample(now)
+        if signals is not None and "fleet.queue_share" in signals:
+            share = signals["fleet.queue_share"]
+            depth = int(signals.get("fleet.queued_depth", 0))
+        else:
+            share = self.fleet.queue_share()
+            depth = self.fleet.queued_depth()
         n = len(self.fleet.replicas)
         if share >= self.up_share and depth >= self.min_queue_depth:
             self._up_ticks += 1
@@ -168,6 +198,9 @@ class FleetAutoscaler:
         _metrics.count(f"autoscale.{action}")
         _trace.instant(f"autoscale.{action}", cat="fleet", replica=rid,
                        queue_share=round(share, 4), depth=depth)
+        _recorder.record("autoscale", f"autoscale.{action}",
+                         f"replica {rid} share={share:.3f} "
+                         f"depth={depth} -> {n_after}")
         log.info(
             "autoscale %s: replica %d (share=%.3f depth=%d -> %d "
             "replicas)", action, rid, share, depth, n_after,

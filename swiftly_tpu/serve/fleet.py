@@ -62,7 +62,9 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
+from ..obs.tower import ControlTower
 from ..resilience import degrade as _degrade
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import WorkerKilled, fault_point as _fault_point
@@ -92,6 +94,60 @@ def _rendezvous_score(off0, rid):
     x = (x * 0x045D9F3B) & 0xFFFFFFFF
     x ^= x >> 16
     return x
+
+
+def _replica_telemetry(service):
+    """Tower-source adapter over one replica's service: the counters/
+    stages contract `ControlTower.fleet_telemetry` sums fleet-wide.
+    ``.get`` defaults keep it safe over stub services (tests)."""
+
+    def export():
+        s = service.stats()
+        out = {
+            "counters": {
+                "served": s.get("n_served", 0),
+                "requests": s.get("n_requests", 0),
+                "shed": s.get("n_shed", 0),
+                "retries": s.get("retries", 0),
+                "cache_hits": s.get("cache_hits", 0),
+            },
+            "p99_ms": s.get("p99_ms", 0.0),
+        }
+        j = s.get("journey")
+        if j:
+            stages = {}
+            for seg in ("queue", "compute", "transfer"):
+                seg_info = j.get(seg)
+                if seg_info:
+                    stages[f"serve.journey.{seg}"] = {
+                        "count": int(j.get("n", 0)),
+                        "total_s": float(seg_info.get("total_s", 0.0)),
+                    }
+            if stages:
+                out["stages"] = stages
+        return out
+
+    return export
+
+
+def _fabric_telemetry(fabric):
+    """Tower-source adapter over the shared cache fabric."""
+
+    def export():
+        s = fabric.stats()
+        return {
+            "counters": {
+                k: s.get(k, 0)
+                for k in ("l1_hits", "l2_hits", "misses", "promotions",
+                          "l1_evictions", "rolls", "dedup_hits",
+                          "dedup_computes")
+            },
+            "hit_ratio": s.get("hit_ratio", 0.0),
+            "stream_version": s.get("stream_version", 0),
+            "views": s.get("views", 0),
+        }
+
+    return export
 
 
 class FleetRequest:
@@ -194,6 +250,8 @@ class Replica:
 
     def _run(self, trace_ctx=0):
         _trace.adopt(trace_ctx)
+        _trace.name_track(threading.get_native_id(),
+                          f"replica-{self.rid}")
         try:
             while not self._stop:
                 if self._kill_flag:
@@ -218,6 +276,8 @@ class Replica:
             _metrics.count("fleet.replica_deaths")
             _trace.instant("fleet.replica_death", cat="fleet",
                            replica=self.rid, error=str(exc))
+            _recorder.record("fleet", "fleet.replica_death",
+                             f"replica {self.rid}: {exc}")
             log.warning("replica %d died: %s", self.rid, exc)
 
     def alive(self):
@@ -321,6 +381,12 @@ class ServeFleet:
         scale-in) gets to finish its backlog before the fleet
         force-revokes its lease and fails the remainder over — the
         zero-loss escape hatch, not the normal path
+    :param tower: optional `obs.tower.ControlTower`; one is built on
+        the fleet's clock when not given. Every replica registers a
+        telemetry source with it, the supervisor tick samples its
+        windowed signals ONCE and hands that sample to both the
+        brownout ladder and the autoscaler, and its SLOs are evaluated
+        every tick.
     """
 
     def __init__(self, replica_factory, n_replicas=3, *,
@@ -334,7 +400,7 @@ class ServeFleet:
                  supervise_interval_s=0.002, poll_s=0.001, seed=0,
                  clock=time.monotonic, hbm_budget_bytes=None,
                  request_bytes=0, column_bytes=0, fabric=None,
-                 drain_timeout_s=30.0):
+                 drain_timeout_s=30.0, tower=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self._clock = clock
@@ -356,6 +422,13 @@ class ServeFleet:
         # the autoscaler (serve.autoscale.FleetAutoscaler) attaches
         # here; the supervisor tick evaluates it when present
         self.autoscaler = None
+        # the control tower: every replica registers a telemetry
+        # source; the supervisor tick samples its signals once for the
+        # brownout ladder + autoscaler and evaluates its SLOs
+        self.tower = tower if tower is not None else ControlTower(
+            clock=clock
+        )
+        self.last_post_mortem = None
         # replica construction state, kept so `add_replica` can scale
         # out after __init__ with the same factory and tuning
         self._replica_factory = replica_factory
@@ -397,6 +470,48 @@ class ServeFleet:
         self._saved_max_batch = {}
         self._sup_stop = False
         self._sup_thread = None
+        # windowed signals: late-bound so instance-attribute overrides
+        # (drill hooks) and live replica sets are always honored
+        self.tower.register_signal(
+            "fleet.queue_share", lambda: self.queue_share()
+        )
+        self.tower.register_signal(
+            "fleet.queued_depth", lambda: float(self.queued_depth())
+        )
+        self.tower.register_signal(
+            "fleet.p99_ms", lambda: self._rolling_p99() * 1e3
+        )
+        self.tower.register_signal("fleet.shed_rate", self._shed_rate)
+        self.tower.register_signal(
+            "fleet.brownout_level",
+            lambda: float(self._brownout_level),
+        )
+        self.tower.register_source(
+            "fleet", self._fleet_telemetry, kind="fleet"
+        )
+        if fabric is not None:
+            self.tower.register_signal(
+                "cache.hit_ratio",
+                lambda: fabric.stats().get("hit_ratio", 0.0),
+            )
+            self.tower.register_source(
+                "fabric", _fabric_telemetry(fabric), kind="cache"
+            )
+
+    def _shed_rate(self):
+        n = self._counts["requests"]
+        return (self._counts["shed"] / n) if n else 0.0
+
+    def _fleet_telemetry(self):
+        """The fleet's own tower source: door counters (prefixed so
+        they never collide with per-replica counter names in the
+        fleet-wide totals)."""
+        with self._lock:
+            counters = {f"fleet.{k}": v for k, v in self._counts.items()}
+            counters["fleet.pending"] = len(self._pending)
+        counters["fleet.n_replicas"] = len(self._replicas)
+        counters["fleet.brownout_level"] = self._brownout_level
+        return {"counters": counters}
 
     # -- topology ------------------------------------------------------------
 
@@ -427,6 +542,10 @@ class ServeFleet:
                 rid, service, lease, breaker, poll_s=self._poll_s
             )
             self._replicas[rid] = replica
+            self.tower.register_source(
+                f"replica-{rid}", _replica_telemetry(service),
+                kind="replica",
+            )
             return replica
 
     @property
@@ -628,7 +747,13 @@ class ServeFleet:
         replicas), settle completed sends, re-route abandoned ones,
         hedge laggards, update the brownout ladder. The supervisor
         thread calls this every ``supervise_interval_s``; tests call it
-        directly with an explicit ``now``."""
+        directly with an explicit ``now``.
+
+        The tower samples every windowed signal ONCE per pass and that
+        sample is what the brownout ladder and the autoscaler both
+        consume — one clock, one value, no consumer-private
+        recomputation (decisions stay bit-identical to when each read
+        the raw signal itself, because the sample IS that read)."""
         now = self._clock() if now is None else now
         for rid, _frm, to in self.monitor.check(now):
             if to == REVOKED:
@@ -637,11 +762,12 @@ class ServeFleet:
             entries = list(self._pending.values())
         for entry in entries:
             self._scan_entry(entry, now)
-        self._update_brownout(now)
+        sample = self.tower.tick(now)
+        self._update_brownout(now, sample)
         self._finalize_drains(now)
         if self.autoscaler is not None:
             try:
-                self.autoscaler.tick(now)
+                self.autoscaler.tick(now, signals=sample)
             except Exception:  # noqa: BLE001 - policy must not kill ticks
                 _metrics.count("fleet.autoscaler_errors")
                 log.exception("autoscaler tick failed")
@@ -885,9 +1011,13 @@ class ServeFleet:
                 self._replicas[rid].service.scheduler.max_batch = saved
             self._saved_max_batch.clear()
 
-    def _update_brownout(self, now):
-        share = self.queue_share()
-        depth = self.queued_depth()
+    def _update_brownout(self, now, sample=None):
+        if sample is not None and "fleet.queue_share" in sample:
+            share = sample["fleet.queue_share"]
+            depth = int(sample.get("fleet.queued_depth", 0))
+        else:
+            share = self.queue_share()
+            depth = self.queued_depth()
         overloaded = (
             share > self.brownout_share
             and depth >= self.brownout_min_depth
@@ -929,6 +1059,7 @@ class ServeFleet:
 
     def _sup_run(self, trace_ctx=0):
         _trace.adopt(trace_ctx)
+        _trace.name_track(threading.get_native_id(), "fleet-supervisor")
         while not self._sup_stop:
             try:
                 self.tick()
@@ -1079,6 +1210,15 @@ class ServeFleet:
                     "failover", rid, self.drain_timeout_s,
                 )
                 _metrics.count("fleet.drains_forced")
+                _recorder.record("fleet", "fleet.drain_forced",
+                                 f"replica {rid} past "
+                                 f"{self.drain_timeout_s:.1f}s")
+                if _recorder.enabled():
+                    # a forced drain is a post-mortem trigger: snapshot
+                    # the black box for the drill artifact to stamp
+                    self.last_post_mortem = _recorder.post_mortem(
+                        "forced_drain", reason=f"replica {rid}"
+                    )
                 # revoke the lease: the monitor's next pass strands the
                 # queue and the ledger scan re-routes every sub
                 replica.lease.revoke()
@@ -1095,6 +1235,7 @@ class ServeFleet:
             return
         replica.stop(timeout=2.0)
         self.monitor.unregister(rid)
+        self.tower.unregister_source(f"replica-{rid}")
         if self.fabric is not None:
             self.fabric.drop_view(rid)
         s = replica.service.stats()
@@ -1107,6 +1248,8 @@ class ServeFleet:
         _metrics.count("fleet.drains")
         _trace.instant("fleet.replica_retired", cat="fleet",
                        replica=rid, reason=reason)
+        _recorder.record("fleet", "fleet.replica_retired",
+                         f"replica {rid}: {reason}")
         log.info("drain: replica %d retired (%s; %d replicas left)",
                  rid, reason, len(self._replicas))
 
@@ -1144,6 +1287,8 @@ class ServeFleet:
         _metrics.count("fleet.restores")
         _trace.instant("fleet.replica_restored", cat="fleet",
                        replica=rid)
+        _recorder.record("fleet", "fleet.replica_restored",
+                         f"replica {rid}")
         return replica
 
     def stop(self, timeout=10.0):

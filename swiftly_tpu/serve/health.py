@@ -35,6 +35,7 @@ import threading
 import time
 
 from ..obs import metrics as _metrics
+from ..obs import recorder as _recorder
 from ..obs import trace as _trace
 from ..resilience.faults import fault_point as _fault_point
 
@@ -178,6 +179,7 @@ class HealthMonitor:
         _metrics.count(f"health.{to}" if to != LIVE else "health.revived")
         _trace.instant("health.transition", cat="health", owner=key,
                        frm=frm, to=to, via=via)
+        _recorder.record("lease", f"health.{key}.{frm}->{to}", via)
 
     def check(self, now=None):
         """One grading pass; returns the transitions it observed as

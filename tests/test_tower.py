@@ -1,0 +1,499 @@
+"""The fleet control tower, flight recorder, and SLO alert engine.
+
+Pins the PR-15 contracts (docs/observability.md, "The control tower"):
+
+* flight recorder: bounded lock-light ring, ON independently of
+  tracing/metrics, post-mortem bundles with per-kind counts and a
+  readable non-stage tail, JSONL + rendered-text dumps (the hot-path
+  <5 us/event budget lives in tests/test_trace.py alongside the other
+  overhead microbenchmarks);
+* control tower: named telemetry sources aggregated into a
+  ``fleet_telemetry`` block whose per-source breakdowns SUM to the
+  fleet totals (validator re-derives the sums), with raising sources
+  isolated instead of fatal;
+* SLO burn-rate alerts: multi-window open/close semantics on an
+  injected clock — a sustained breach opens, a one-sample blip does
+  not, recovery closes — and the ``alerts`` block validator's failure
+  modes;
+* heartbeat fleet fields, per-source trace tracks
+  (``report.by_source``), ``scripts/tower_report.py`` end to end,
+  ``scripts/bench_compare.py --list-sentinels``, and the
+  telemetry-vocabulary drift guard over docs/observability.md.
+"""
+
+import json
+import re
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from swiftly_tpu.obs import (
+    SLO,
+    ControlTower,
+    metrics,
+    recorder,
+    report,
+    trace,
+    validate_alerts_artifact,
+    validate_fleet_telemetry_artifact,
+)
+from swiftly_tpu.obs.heartbeat import Heartbeat
+from swiftly_tpu.obs.recorder import FlightRecorder, render_post_mortem
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+
+@pytest.fixture
+def obs_sandbox():
+    """Tracer, registry and global recorder all off (and wiped) around
+    the test — tests may enable what they need inside."""
+    def _wipe():
+        trace.get_tracer().disable()
+        trace.get_tracer().reset()
+        metrics.get_registry().disable()
+        metrics.get_registry().reset()
+        recorder.disable()
+        recorder.reset()
+    _wipe()
+    yield
+    _wipe()
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_is_bounded_and_ordered():
+    rec = FlightRecorder(enabled=True, capacity=8, seconds=60.0)
+    for i in range(20):
+        rec.record("fleet", f"ev-{i}")
+    evs = rec.events()
+    assert len(evs) == 8  # oldest 12 evicted
+    assert [e["name"] for e in evs] == [f"ev-{i}" for i in range(12, 20)]
+    assert all(evs[i]["t"] <= evs[i + 1]["t"] for i in range(len(evs) - 1))
+
+
+def test_recorder_disabled_records_nothing():
+    rec = FlightRecorder(enabled=False)
+    rec.record("fault", "fault.injected.x")
+    assert rec.events() == []
+    rec.enable()
+    rec.record("fault", "fault.injected.x")
+    assert len(rec.events()) == 1
+    rec.disable()
+    rec.record("fault", "fault.injected.y")
+    assert len(rec.events()) == 1
+
+
+def test_recorder_window_filters_old_events():
+    rec = FlightRecorder(enabled=True)
+    rec.record("fleet", "old")
+    assert len(rec.events(seconds=1e9)) == 1
+    assert rec.events(seconds=0.0) == []
+
+
+def test_post_mortem_counts_kinds_and_tails_non_stage_events():
+    rec = FlightRecorder(enabled=True)
+    for i in range(100):
+        rec.record("stage", "fwd.column_pass", 0.001)
+    rec.record("fault", "fault.injected.bwd.feed", "kill call 3")
+    rec.record("degrade", "degrade.checkpoint.resume")
+    pm = rec.post_mortem("WorkerKilled", reason="drill")
+    assert pm["trigger"] == "WorkerKilled" and pm["reason"] == "drill"
+    assert pm["n_events"] == 102
+    assert pm["by_kind"] == {"stage": 100, "fault": 1, "degrade": 1}
+    # the tail is the readable story: decisions, not stage volume
+    assert [e["kind"] for e in pm["events"]] == ["fault", "degrade"]
+    txt = render_post_mortem(pm)
+    assert "WorkerKilled" in txt and "fault.injected.bwd.feed" in txt
+
+
+def test_recorder_dump_writes_jsonl_and_txt(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    rec.record("fault", "fault.injected.mesh.shard_loss")
+    rec.record("mesh", "mesh.recovery.resumed")
+    path = tmp_path / "pm.jsonl"
+    bundle = rec.dump(path, "ShardLostError", reason="drill")
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "post_mortem"
+    assert lines[0]["trigger"] == "ShardLostError"
+    assert [l["name"] for l in lines[1:]] == [
+        "fault.injected.mesh.shard_loss", "mesh.recovery.resumed",
+    ]
+    assert "ShardLostError" in (tmp_path / "pm.jsonl.txt").read_text()
+    assert bundle["n_events"] == 2 and rec.dumps == 1
+
+
+def test_stage_bridge_records_with_registry_and_tracer_off(obs_sandbox):
+    # metrics.stage must reach the ring when ONLY the recorder is on
+    recorder.enable()
+    with metrics.stage("fwd.column_pass"):
+        pass
+    evs = recorder.events()
+    assert len(evs) == 1
+    assert evs[0]["kind"] == "stage"
+    assert evs[0]["name"] == "fwd.column_pass"
+    assert evs[0]["detail"] >= 0.0  # the measured wall rides in detail
+
+
+# ---------------------------------------------------------------------------
+# Control tower: source aggregation
+# ---------------------------------------------------------------------------
+
+
+def _source(counters=None, stages=None):
+    block = {}
+    if counters:
+        block["counters"] = counters
+    if stages:
+        block["stages"] = stages
+    return lambda: block
+
+
+def test_fleet_telemetry_totals_sum_per_source_breakdowns():
+    tower = ControlTower()
+    tower.register_source(
+        "replica-0",
+        _source({"serve.served": 10}, {"serve.batch": {"count": 4, "total_s": 0.4}}),
+    )
+    tower.register_source(
+        "replica-1",
+        _source({"serve.served": 32}, {"serve.batch": {"count": 6, "total_s": 0.2}}),
+    )
+    tower.register_source(
+        "fabric", _source({"cache.l2_hits": 7}), kind="cache"
+    )
+    ft = tower.fleet_telemetry()
+    assert ft["n_sources"] == 3
+    assert ft["sources"]["replica-0"]["kind"] == "replica"
+    assert ft["sources"]["fabric"]["kind"] == "cache"
+    assert ft["totals"]["counters"] == {
+        "serve.served": 42, "cache.l2_hits": 7,
+    }
+    assert ft["totals"]["stages"]["serve.batch"] == {
+        "count": 10, "total_s": 0.6,
+    }
+    assert validate_fleet_telemetry_artifact({"fleet_telemetry": ft}) == []
+
+
+def test_fleet_telemetry_validator_trips_on_doctored_totals():
+    tower = ControlTower()
+    tower.register_source("replica-0", _source({"serve.served": 10}))
+    ft = tower.fleet_telemetry()
+    ft["totals"]["counters"]["serve.served"] = 11  # the lie
+    problems = validate_fleet_telemetry_artifact({"fleet_telemetry": ft})
+    assert problems and "serve.served" in problems[0]
+    assert validate_fleet_telemetry_artifact({}) == [
+        "missing fleet_telemetry block"
+    ]
+    assert validate_fleet_telemetry_artifact(
+        {"fleet_telemetry": {"sources": {}}}
+    ) == ["fleet_telemetry has no sources"]
+
+
+def test_raising_source_is_isolated_not_fatal():
+    tower = ControlTower()
+    tower.register_source("replica-0", _source({"serve.served": 1}))
+
+    def bad():
+        raise RuntimeError("replica gone")
+
+    tower.register_source("replica-1", bad)
+    ft = tower.fleet_telemetry()
+    assert ft["sources"]["replica-1"]["error"] == "replica gone"
+    assert ft["source_errors"] >= 1
+    # the healthy source still aggregates, and the block still validates
+    assert ft["totals"]["counters"] == {"serve.served": 1}
+    assert validate_fleet_telemetry_artifact({"fleet_telemetry": ft}) == []
+
+
+def test_unregister_source_removes_it_from_the_export():
+    tower = ControlTower()
+    tower.register_source("replica-0", _source({"x": 1}))
+    tower.unregister_source("replica-0")
+    assert tower.fleet_telemetry()["n_sources"] == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate alerts (injected clock)
+# ---------------------------------------------------------------------------
+
+
+def _slo_rig(threshold=100.0, fast_s=1.0, slow_s=5.0, burn=0.5):
+    t = [0.0]
+    val = [0.0]
+    tower = ControlTower(clock=lambda: t[0])
+    tower.register_signal("p99", lambda: val[0])
+    tower.set_slos([
+        SLO("lat", "p99", threshold, direction="above",
+            fast_s=fast_s, slow_s=slow_s, burn=burn),
+    ])
+    return tower, t, val
+
+
+def test_sustained_breach_opens_then_recovery_closes(obs_sandbox):
+    recorder.enable()
+    tower, t, val = _slo_rig()
+    for _ in range(10):          # healthy 5s baseline
+        tower.tick()
+        t[0] += 0.5
+    assert tower.open_alerts() == []
+    val[0] = 250.0
+    for _ in range(12):          # sustained 6s breach fills both windows
+        tower.tick()
+        t[0] += 0.5
+    open_alerts = tower.open_alerts()
+    assert len(open_alerts) == 1
+    assert open_alerts[0]["slo"] == "lat"
+    assert open_alerts[0]["fast_burn"] >= 0.5
+    val[0] = 50.0
+    for _ in range(4):           # fast window clears -> close
+        tower.tick()
+        t[0] += 0.5
+    assert tower.open_alerts() == []
+    block = tower.alerts_block()
+    assert block["opened"] == 1 and block["closed"] == 1
+    assert [e["action"] for e in block["events"]] == ["open", "close"]
+    assert validate_alerts_artifact({"alerts": block}) == []
+    # the transitions also landed in the black box
+    names = [e["name"] for e in recorder.events()]
+    assert "alert.lat.open" in names and "alert.lat.close" in names
+
+
+def test_one_sample_blip_does_not_open():
+    # the slow window is the flap guard: one breached sample satisfies
+    # the fast window but not the slow one
+    tower, t, val = _slo_rig()
+    for _ in range(9):
+        tower.tick()
+        t[0] += 0.5
+    val[0] = 250.0
+    tower.tick()
+    t[0] += 0.5
+    val[0] = 50.0
+    for _ in range(3):
+        tower.tick()
+        t[0] += 0.5
+    assert tower.open_alerts() == []
+    assert tower.alerts_block()["opened"] == 0
+
+
+def test_slo_constructor_rejects_bad_specs():
+    with pytest.raises(ValueError):
+        SLO("x", "s", 1.0, direction="sideways")
+    with pytest.raises(ValueError):
+        SLO("x", "s", 1.0, burn=0.0)
+    with pytest.raises(ValueError):
+        SLO("x", "s", 1.0, fast_s=5.0, slow_s=1.0)
+    below = SLO("x", "s", 0.9, direction="below")
+    assert below.breached(0.5) and not below.breached(0.95)
+
+
+def test_validate_alerts_artifact_failure_modes():
+    assert validate_alerts_artifact({}) == ["missing alerts block"]
+    bad = {
+        "slos": [{"name": "x"}],                      # incomplete spec
+        "open": [],
+        "events": [{"slo": "x", "t": 0.0, "action": "page"}],
+        "opened": 1,
+        "closed": 2,                                  # closed > opened
+    }
+    problems = validate_alerts_artifact({"alerts": bad})
+    assert any("missing 'signal'" in p for p in problems)
+    assert any("not open/close" in p for p in problems)
+    assert any("closed 2 > opened 1" in p for p in problems)
+    # ledger consistency: open list must equal opened - closed
+    ledger = {
+        "slos": [], "open": [], "events": [], "opened": 2, "closed": 1,
+    }
+    problems = validate_alerts_artifact({"alerts": ledger})
+    assert any("0 open alert(s) != opened 2" in p for p in problems)
+
+
+def test_window_mean_and_signal_read_back():
+    tower, t, val = _slo_rig()
+    for v in (10.0, 20.0, 30.0):
+        val[0] = v
+        tower.tick()
+        t[0] += 1.0
+    assert tower.signal("p99") == 30.0
+    assert tower.window_mean("p99", 10.0) == 20.0
+    assert tower.window_mean("p99", 1.5) == 30.0  # only the newest
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat fleet fields
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_carries_tower_fleet_fields(tmp_path, obs_sandbox):
+    tower = ControlTower()
+    tower.register_source("replica-0", _source({"x": 1}))
+    tower.register_source("replica-1", _source({"x": 1}))
+    tower.register_signal("fleet.queued_depth", lambda: 3.0)
+    tower.register_signal("fleet.brownout_level", lambda: 1.0)
+    tower.tick()
+    fields = tower.heartbeat_fields()
+    assert fields == {
+        "fleet_replicas": 2,
+        "fleet_open_alerts": 0,
+        "fleet_queue_depth": 3,
+        "fleet_brownout_level": 1,
+    }
+    jsonl = tmp_path / "hb.jsonl"
+    metrics.enable(str(jsonl))
+    hb = Heartbeat(total=4, interval_s=0.0, tower=tower)
+    hb.update(2)
+    hb.finish()
+    metrics.disable()
+    beats = [
+        json.loads(l) for l in jsonl.read_text().splitlines()
+        if json.loads(l).get("kind") == "heartbeat"
+    ]
+    assert beats and beats[-1]["fleet_replicas"] == 2
+    assert beats[-1]["fleet_queue_depth"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Per-source trace tracks
+# ---------------------------------------------------------------------------
+
+
+def test_by_source_groups_attribution_by_named_track(obs_sandbox):
+    tr = trace.get_tracer()
+    tr.enable()
+    trace.name_track(threading.get_native_id(), "replica-7")
+    with trace.span("serve.batch"):
+        pass
+    trace.instant("fleet.hbm_shed", cat="fleet")
+    rows = report.by_source(trace.export())
+    labels = {r["label"] for r in rows}
+    assert "replica-7" in labels
+    row = next(r for r in rows if r["label"] == "replica-7")
+    assert row["spans"] >= 1 and row["events"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# tower_report.py end to end
+# ---------------------------------------------------------------------------
+
+
+def _drill_record():
+    tower = ControlTower()
+    tower.register_source(
+        "replica-0",
+        _source({"serve.served": 5}, {"serve.batch": {"count": 2, "total_s": 0.1}}),
+    )
+    rec = FlightRecorder(enabled=True)
+    rec.record("fault", "fault.injected.fleet.replica.kill")
+    rec.record("fleet", "fleet.replica_death", "rid=0")
+    return {
+        "metric": "fleet drill",
+        "fleet_telemetry": tower.fleet_telemetry(),
+        "alerts": tower.alerts_block(),
+        "post_mortem": rec.post_mortem("WorkerKilled", reason="test"),
+    }
+
+
+def test_tower_report_renders_and_validates(tmp_path, capsys):
+    from scripts.tower_report import main
+
+    path = tmp_path / "BENCH_fleet.json"
+    path.write_text(json.dumps(_drill_record()))
+    assert main([str(path), "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["problems"] == []
+    assert summary["fleet_telemetry"]["n_sources"] == 1
+    assert summary["post_mortem"]["trigger"] == "WorkerKilled"
+    # text mode renders all three blocks
+    assert main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet telemetry" in out and "alerts:" in out
+    assert "post-mortem: WorkerKilled" in out
+
+
+def test_tower_report_trips_on_doctored_artifact(tmp_path, capsys):
+    from scripts.tower_report import main
+
+    record = _drill_record()
+    record["fleet_telemetry"]["totals"]["counters"]["serve.served"] = 99
+    path = tmp_path / "BENCH_fleet.json"
+    path.write_text(json.dumps(record))
+    assert main([str(path), "--json"]) == 1
+    assert main([str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# bench_compare --list-sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_bench_compare_lists_the_sentinel_table(capsys):
+    from scripts.bench_compare import SENTINELS, main
+
+    assert main(["--list-sentinels", "--json"]) == 0
+    table = json.loads(capsys.readouterr().out)["sentinels"]
+    assert table == SENTINELS and len(table) >= 10
+    for row in table:
+        assert {"name", "direction", "threshold", "source_pr"} <= set(row)
+    names = {row["name"] for row in table}
+    assert {"wall", "p99_ms", "cache.hit_ratio",
+            "fleet.stream_copies"} <= names
+    # without --list-sentinels a latest artifact is still required
+    with pytest.raises(SystemExit):
+        main([])
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry-vocabulary drift guard
+# ---------------------------------------------------------------------------
+
+_METRIC_RE = re.compile(
+    r'(?:_metrics|metrics)\.(?:count|gauge|gauge_max|stage|observe)'
+    r'\(\s*(f?)"([^"]+)"'
+)
+_INSTANT_RE = re.compile(
+    r'(?:_trace|trace|otrace)\.instant\(\s*(f?)"([^"]+)"'
+)
+_RECORD_RE = re.compile(
+    r'(?:_recorder|recorder|orecorder)\.record\(\s*"([^"]+)",\s*(f?)"([^"]+)"'
+)
+
+
+def engine_telemetry_names():
+    """Every metric/trace-instant/recorder name the engine can emit,
+    f-string names reduced to their literal prefix."""
+    names = set()
+    for path in (REPO / "swiftly_tpu").rglob("*.py"):
+        src = path.read_text()
+        for fprefix, name in _METRIC_RE.findall(src) + _INSTANT_RE.findall(src):
+            if fprefix:
+                name = name.split("{")[0]
+            if name:
+                names.add(name)
+        for _kind, fprefix, name in _RECORD_RE.findall(src):
+            if fprefix:
+                name = name.split("{")[0]
+            if name:
+                names.add(name)
+    return names
+
+
+def test_every_telemetry_name_is_documented():
+    # the drift guard: a new metrics.count/gauge/stage, trace.instant
+    # or recorder.record name must land in docs/observability.md in
+    # the same PR that introduces it
+    names = engine_telemetry_names()
+    assert len(names) > 100  # the extraction itself must keep working
+    doc = (REPO / "docs" / "observability.md").read_text()
+    missing = sorted(n for n in names if n not in doc)
+    assert missing == [], (
+        f"{len(missing)} telemetry name(s) missing from "
+        f"docs/observability.md: {missing}"
+    )
