@@ -921,7 +921,7 @@ def run_one(config_name, mode):
                 # the stream, later feeds are cache-fed
                 feed_backward_passes(
                     fwd, subgrid_configs, bwds, spill=spill,
-                    progress=hb.update,
+                    progress=hb.update, feed_index=kfeed,
                 )
                 for bwd, (i0, i1, r0, r1) in zip(bwds, chunk):
                     facets_dev = bwd.finish_device()
@@ -1163,12 +1163,11 @@ def run_one(config_name, mode):
     if "plan_compiled" in extra:
         # close the loop: the stamped plan carries predicted vs MEASURED
         # wall, which is what bench_compare's mispricing flag and the
-        # autotune history read back
-        pc = extra["plan_compiled"]
-        pc["measured_wall_s"] = round(elapsed, 4)
-        pred = (pc.get("predicted") or {}).get("wall_s") or 0
-        if pred and elapsed:
-            pc["predicted_vs_measured"] = round(pred / elapsed, 3)
+        # autotune history read back (sig-fig rounding — a decimal
+        # round zeroed sub-0.1 ms smoke legs and dropped the ratio)
+        from swiftly_tpu.plan import stamp_measured_wall
+
+        stamp_measured_wall(extra["plan_compiled"], elapsed)
     direction = (
         "forward+backward round-trip"
         if mode in ("roundtrip", "roundtrip-streamed")
@@ -1216,6 +1215,8 @@ def run_one(config_name, mode):
     )
     if metrics.enabled():
         result["telemetry"] = metrics.export()
+        if "plan_compiled" in result:
+            _stamp_plan_accuracy(result)
     if otrace.enabled():
         from swiftly_tpu.obs import summarize_trace
 
@@ -1259,6 +1260,42 @@ def _maybe_enable_trace():
 
         otrace.enable(path)
     return path
+
+
+def _stamp_plan_accuracy(record, dump_path=None):
+    """Close the plan-accuracy loop for one leg: join the stamped
+    ``plan_compiled`` block against the leg's telemetry into a
+    ``plan_accuracy`` block (obs.ledger), append it to the persisted
+    calibration history (``SWIFTLY_CALIBRATION_HISTORY``; ``0``
+    disables), and — when CALIBRATED stages mispriced beyond the
+    threshold — land ``plan.mispriced`` flight-recorder events plus a
+    post-mortem dump. Returns the block (also stamped into the
+    record)."""
+    from swiftly_tpu.obs import ledger as oledger
+
+    block = oledger.plan_accuracy_block(
+        record.get("plan_compiled"),
+        record.get("telemetry"),
+        manifest=record.get("manifest"),
+    )
+    record["plan_accuracy"] = block
+    try:
+        oledger.append_history(block)
+    except OSError as exc:
+        log.warning("calibration history append failed: %s", exc)
+    threshold = float(os.environ.get("BENCH_PLAN_THRESHOLD", "2.0"))
+    mispriced = oledger.record_mispricing(
+        block, threshold=threshold,
+        dump_path=dump_path or os.environ.get(
+            "BENCH_PLAN_PM_OUT", "BENCH_plan_postmortem.jsonl"
+        ),
+    )
+    if mispriced:
+        log.warning(
+            "calibrated plan mispriced beyond x%g: %s", threshold,
+            ", ".join(f"{n} (x{r:g})" for n, r in mispriced),
+        )
+    return block
 
 
 def _maybe_enable_recorder():
@@ -2497,6 +2534,7 @@ def mesh_bench(smoke_mode=False):
         metrics,
         run_manifest,
         validate_mesh_artifact,
+        validate_plan_accuracy_artifact,
         validate_plan_artifact,
     )
 
@@ -2559,11 +2597,14 @@ def mesh_bench(smoke_mode=False):
         spill = SpillCache(budget_bytes=2e9)
         parts = []
         t0 = time.time()
-        for c0 in range(0, len(subsets), feed_group_env):
+        for kfeed, c0 in enumerate(
+            range(0, len(subsets), feed_group_env)
+        ):
             chunk = subsets[c0 : c0 + feed_group_env]
             bwds = [make_bwd(i0, i1) for i0, i1 in chunk]
             feed_backward_passes(
-                fwd_exec, subgrid_configs, bwds, spill=spill
+                fwd_exec, subgrid_configs, bwds, spill=spill,
+                feed_index=kfeed,
             )
             parts.extend(np.asarray(bwd.finish()) for bwd in bwds)
         wall = time.time() - t0
@@ -2697,6 +2738,10 @@ def mesh_bench(smoke_mode=False):
         params={"config": name, "mode": "mesh-streamed", **params},
     )
     record["telemetry"] = metrics.export()
+    # per-stage predicted-vs-measured reconciliation — the mesh leg is
+    # where the plan's mesh.psum pricing meets its measured stage
+    _stamp_plan_accuracy(record)
+    problems.extend(validate_plan_accuracy_artifact(record))
     if trace_path:
         from swiftly_tpu.obs import summarize_trace
         from swiftly_tpu.obs import trace as otrace
@@ -3265,6 +3310,15 @@ def smoke():
     # cache-fed h2d path (prefetch hits, spill.h2d) would never run
     os.environ.setdefault("BENCH_BWD_FACET_PASSES", "2")
     os.environ.setdefault("BENCH_BWD_FEED_GROUP", "1")
+    # calibration history lands next to the smoke artifact unless the
+    # operator pointed SWIFTLY_CALIBRATION_HISTORY elsewhere (0 = off)
+    os.environ.setdefault(
+        "SWIFTLY_CALIBRATION_HISTORY",
+        os.path.join(
+            os.path.dirname(os.path.abspath(out_path)),
+            "BENCH_calibration.jsonl",
+        ),
+    )
     metrics.enable(jsonl_path)
     name = os.environ.get("BENCH_SMOKE_CONFIG", "1k[1]-n512-256")
     record = run_one(name, "roundtrip-streamed")
@@ -3361,6 +3415,20 @@ def smoke():
         )
     if "bwd.feed_group" not in stages:
         problems.append("telemetry missing the bwd.feed_group stage")
+    # plan-accuracy ledger schema: every smoke run stamps the per-stage
+    # predicted-vs-measured reconciliation, and the join must cover at
+    # least 80% of the plan-priced stage wall — uncovered stages are
+    # listed by name, so a timer falling out of the mapping fails HERE
+    from swiftly_tpu.obs import validate_plan_accuracy_artifact
+
+    problems.extend(validate_plan_accuracy_artifact(record))
+    pa = record.get("plan_accuracy") or {}
+    coverage = pa.get("coverage")
+    if not isinstance(coverage, (int, float)) or coverage < 0.8:
+        problems.append(
+            f"plan_accuracy coverage {coverage!r} < 0.8 of plan-priced "
+            f"stage wall (uncovered: {pa.get('uncovered')})"
+        )
     stream_bytes = (record.get("spill") or {}).get("ram_bytes", 0) + (
         record.get("spill") or {}
     ).get("disk_bytes", 0)
@@ -4200,6 +4268,12 @@ def mesh_chaos(smoke_mode=False):
         baseline_source=None, params=dict(SWIFT_CONFIGS[name])
     )
     record["telemetry"] = metrics.export()
+    if record.get("plan_compiled"):
+        _stamp_plan_accuracy(
+            record,
+            dump_path=os.path.splitext(out_path)[0]
+            + "_plan_postmortem.jsonl",
+        )
     if trace_path:
         from swiftly_tpu.obs import summarize_trace
         from swiftly_tpu.obs import trace as otrace

@@ -81,14 +81,22 @@ JSONL of records (``BENCH_partial.jsonl``), or the round-ledger shape
 
 Since the unified plan compiler (PR 7), records may also carry a
 ``plan_compiled`` block with a predicted wall next to the measured one.
-A calibrated plan (``coeffs_source == "measured"``) whose predicted and
-measured walls diverge more than ``--plan-threshold`` x (default 2x) is
+A calibrated plan (``coeffs_source`` of ``"measured"`` or — since the
+plan-accuracy ledger — ``"ledger"``) whose predicted and measured
+walls diverge more than ``--plan-threshold`` x (default 2x) is
 **flagged as mispriced** — a mispriced cost model quietly produces bad
 plans on every future run, which is a regression in its own right.
-Uncalibrated (default-coefficient) predictions are reported but never
-flagged: a CPU smoke run racing TPU-anchored defaults is a category
-error, like the cross-platform wall comparison above. Mispricing flips
-the exit code only under ``--fail-on-mispriced``.
+The ratio is always **predicted / measured**: > 1 means the plan
+OVER-predicted the wall (the run beat the price), < 1 means the plan
+was optimistic (the run was slower than priced). Records stamping a
+``plan_accuracy`` block (obs.ledger) additionally get the
+``plan.stage_accuracy`` sentinel: each calibrated STAGE whose ratio
+leaves the same ``[1/x, x]`` band is flagged by name, with the block's
+coverage fraction reported alongside. Uncalibrated
+(default-coefficient) predictions are reported but never flagged: a
+CPU smoke run racing TPU-anchored defaults is a category error, like
+the cross-platform wall comparison above. Mispricing flips the exit
+code only under ``--fail-on-mispriced``.
 
 Usage:
     python scripts/bench_compare.py BENCH_smoke.json \
@@ -195,6 +203,16 @@ SENTINELS = [
         "threshold": "ANY increase over best reference",
         "source_pr": 13,
         "applies_to": "fleet legs with the shared cache fabric",
+    },
+    {
+        "name": "plan.stage_accuracy",
+        "direction": "per-stage ratio in [1/x, x]",
+        "threshold": "--plan-threshold (default 2.0x) per-stage "
+                     "predicted/measured; calibrated coeffs "
+                     "(measured|ledger) only",
+        "source_pr": 16,
+        "applies_to": "legs stamping a plan_accuracy block "
+                      "(obs.ledger)",
     },
 ]
 
@@ -504,10 +522,21 @@ def plan_verdicts(latest_records, plan_threshold=2.0):
     """Mispricing verdicts for every ``plan_compiled`` block that
     carries both a predicted and a measured wall.
 
-    A CALIBRATED plan (``coeffs_source == "measured"``) whose ratio
-    falls outside [1/plan_threshold, plan_threshold] is ``mispriced``;
-    default-coefficient predictions are reported with
-    ``mispriced: False`` always (ranking anchors, not a contract)."""
+    ``ratio`` is **predicted / measured**: > 1 means the plan
+    OVER-predicted the wall (the run beat the price), < 1 means the
+    plan was optimistic (the run was slower than priced). A CALIBRATED
+    plan (``coeffs_source`` of ``"measured"`` or ``"ledger"``) whose
+    ratio falls outside [1/plan_threshold, plan_threshold] is
+    ``mispriced``; default-coefficient predictions are reported with
+    ``mispriced: False`` always (ranking anchors, not a contract).
+
+    Records stamping a ``plan_accuracy`` block (obs.ledger) also get
+    the per-stage sentinel: ``stage_coverage`` (fraction of plan-priced
+    stage wall with a measured counterpart), ``uncovered_stages``, and
+    ``mispriced_stages`` — each calibrated stage whose own
+    predicted/measured ratio leaves the same band, flagged by name.
+    Stage-level mispricing flips ``mispriced`` exactly like the
+    whole-leg ratio."""
     verdicts = []
     for rec in latest_records:
         block = rec.get("plan_compiled")
@@ -524,21 +553,44 @@ def plan_verdicts(latest_records, plan_threshold=2.0):
             continue
         key = leg_key(rec) or ("?", block.get("mode", "?"))
         ratio = predicted / measured
-        calibrated = block.get("coeffs_source") == "measured"
-        verdicts.append(
-            {
-                "config": key[0],
-                "mode": key[1],
-                "coeffs_source": block.get("coeffs_source"),
-                "predicted_wall_s": predicted,
-                "measured_wall_s": measured,
-                "ratio": round(ratio, 3),
-                "mispriced": calibrated
-                and not (
-                    1.0 / plan_threshold <= ratio <= plan_threshold
-                ),
-            }
+        calibrated = block.get("coeffs_source") in (
+            "measured", "ledger"
         )
+        verdict = {
+            "config": key[0],
+            "mode": key[1],
+            "coeffs_source": block.get("coeffs_source"),
+            "predicted_wall_s": predicted,
+            "measured_wall_s": measured,
+            "ratio": round(ratio, 3),
+            "ratio_direction": "predicted/measured (>1 = plan "
+                               "over-predicted, <1 = plan optimistic)",
+            "mispriced": calibrated
+            and not (
+                1.0 / plan_threshold <= ratio <= plan_threshold
+            ),
+        }
+        accuracy = rec.get("plan_accuracy")
+        if isinstance(accuracy, dict):
+            verdict["stage_coverage"] = accuracy.get("coverage")
+            verdict["uncovered_stages"] = accuracy.get("uncovered")
+            bad = []
+            for name, entry in (accuracy.get("stages") or {}).items():
+                r = (
+                    entry.get("ratio")
+                    if isinstance(entry, dict) else None
+                )
+                if (
+                    isinstance(r, (int, float)) and r > 0
+                    and not (
+                        1.0 / plan_threshold <= r <= plan_threshold
+                    )
+                ):
+                    bad.append({"stage": name, "ratio": r})
+            verdict["mispriced_stages"] = bad
+            if calibrated and bad:
+                verdict["mispriced"] = True
+        verdicts.append(verdict)
     return verdicts
 
 
@@ -653,8 +705,26 @@ def main(argv=None):
                 f"{status:>9}  {p['config']} ({p['mode']}, "
                 f"{p['coeffs_source']} coeffs): predicted "
                 f"{p['predicted_wall_s']:.4g}s vs measured "
-                f"{p['measured_wall_s']:.4g}s (x{p['ratio']})"
+                f"{p['measured_wall_s']:.4g}s "
+                f"(predicted/measured x{p['ratio']}; >1 = plan "
+                "over-predicted, <1 = plan optimistic)"
             )
+            if p.get("stage_coverage") is not None:
+                print(
+                    f"           stage coverage "
+                    f"{p['stage_coverage']:.0%}"
+                    + (
+                        f", uncovered: "
+                        f"{', '.join(p['uncovered_stages'])}"
+                        if p.get("uncovered_stages")
+                        else ""
+                    )
+                )
+            for s in p.get("mispriced_stages") or []:
+                print(
+                    f"           - stage {s['stage']} "
+                    f"predicted/measured x{s['ratio']}"
+                )
         if not report["legs"] and not report["skipped"]:
             print("nothing comparable (no matching legs)")
     if report["regressions"]:

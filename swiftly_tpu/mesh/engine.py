@@ -287,6 +287,14 @@ class MeshStreamedForward(StreamedForward):
 
             def sync():
                 _fault_point("mesh.psum")
+                # split the block: the wait on the group's psum is the
+                # plan's mesh.psum ICI stage, the host copy after it is
+                # spill.write — timed apart so the plan-accuracy ledger
+                # (obs.ledger) joins each against its own priced stage
+                with _metrics.stage("mesh.psum") as st:
+                    if hasattr(out_g, "block_until_ready"):
+                        out_g.block_until_ready()
+                        st.bytes_moved = int(getattr(out_g, "nbytes", 0))
                 with _metrics.stage("spill.write") as st:
                     arr = host_replica(out_g)
                     st.bytes_moved = int(arr.nbytes)

@@ -47,8 +47,9 @@ Enable via ``SWIFTLY_METRICS=1`` (JSONL path in
 docs/observability.md.
 """
 
-from . import metrics, recorder, report, tower, trace
+from . import ledger, metrics, recorder, report, tower, trace
 from .heartbeat import Heartbeat, PartialArtifactWriter
+from .ledger import validate_plan_accuracy_artifact
 from .manifest import (
     run_manifest,
     validate_artifact,
@@ -72,6 +73,7 @@ __all__ = [
     "Heartbeat",
     "PartialArtifactWriter",
     "SLO",
+    "ledger",
     "metrics",
     "recorder",
     "report",
@@ -85,6 +87,7 @@ __all__ = [
     "validate_fleet_telemetry_artifact",
     "validate_fleet_artifact",
     "validate_mesh_artifact",
+    "validate_plan_accuracy_artifact",
     "validate_plan_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",

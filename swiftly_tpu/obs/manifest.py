@@ -583,10 +583,12 @@ def validate_plan_artifact(record):
             f"plan measured_wall_s {block['measured_wall_s']!r} is "
             "not a number"
         )
-    if block.get("coeffs_source") not in (None, "default", "measured"):
+    if block.get("coeffs_source") not in (
+        None, "default", "measured", "ledger"
+    ):
         problems.append(
             f"plan coeffs_source {block.get('coeffs_source')!r} not "
-            "default|measured"
+            "default|measured|ledger"
         )
     mesh = block.get("mesh")
     if isinstance(mesh, dict):

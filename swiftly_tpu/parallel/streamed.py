@@ -3832,7 +3832,7 @@ def col_group_for_budget(base, budget, n_cols, real=False,
 
 
 def feed_backward_passes(forward, subgrid_configs, backwards, spill=None,
-                         progress=None):
+                         progress=None, feed_index=None):
     """Feed ONE pass over the subgrid stream to MANY backward passes.
 
     A facet × row-slab partitioned backward runs P independent
@@ -3861,7 +3861,13 @@ def feed_backward_passes(forward, subgrid_configs, backwards, spill=None,
     cache-fed h2d bytes attributed — the measured counterpart of the
     plan's ``bwd.feed_group`` stage prediction, refit by
     `plan.autotune` like any other stage. Counters: ``bwd.feed_groups``
-    (feeds run) and ``bwd.feed_passes`` (passes served).
+    (feeds run) and ``bwd.feed_passes`` (passes served). When the
+    caller stamps ``feed_index`` and a LATER feed (index > 0) runs
+    uncached — the replay spill policy, where each feed past the first
+    re-runs the forward — the blocked-on-feed wall is recorded as
+    ``fwd.replay`` instead, the measured counterpart of the plan's
+    replay pricing (`plan.model.price_backward`, ``allow_spill=False``).
+    The plan-accuracy ledger (`obs.ledger`) joins both names.
 
     :param forward: a `StreamedForward` (or `mesh.MeshStreamedForward`)
     :param subgrid_configs: the cover every pass consumes
@@ -3869,6 +3875,9 @@ def feed_backward_passes(forward, subgrid_configs, backwards, spill=None,
     :param spill: the shared `utils.spill.SpillCache` (pass 1 of the
         whole schedule records it; later feeds replay from it)
     :param progress: optional callable(n_subgrids_folded) — heartbeat
+    :param feed_index: this feed's position in the schedule (0-based);
+        lets an uncached later feed attribute its wall to
+        ``fwd.replay`` (None: always ``bwd.feed_group``)
     :returns: number of column groups fed
     """
     backwards = list(backwards)
@@ -3900,9 +3909,17 @@ def feed_backward_passes(forward, subgrid_configs, backwards, spill=None,
     if _metrics.enabled():
         _metrics.count("bwd.feed_groups")
         _metrics.count("bwd.feed_passes", len(backwards))
-        _metrics.observe(
-            "bwd.feed_group", feed_wall, bytes_moved=feed_bytes
-        )
+        if feed_index is not None and feed_index > 0 and not cached:
+            # uncached later feed: the forward re-ran to regenerate the
+            # stream, so the blocked wall is replay cost — the plan's
+            # fwd.replay stage, not shared-feed traffic
+            _metrics.observe(
+                "fwd.replay", feed_wall, bytes_moved=feed_bytes
+            )
+        else:
+            _metrics.observe(
+                "bwd.feed_group", feed_wall, bytes_moved=feed_bytes
+            )
     return n_groups
 
 

@@ -28,7 +28,12 @@ peak and the rejected alternatives; see docs/planning.md.
 """
 
 from . import autotune, compiler, model
-from .autotune import load_history, refit
+from .autotune import (
+    ledger_readiness,
+    load_history,
+    refit,
+    refit_from_ledger,
+)
 from .compiler import (
     BackwardPlan,
     CacheTierPlan,
@@ -42,6 +47,7 @@ from .compiler import (
     plan_delta,
     plan_mesh_layout,
     price_cache_tier,
+    stamp_measured_wall,
 )
 from .model import (
     CostCoefficients,
@@ -70,6 +76,7 @@ __all__ = [
     "compile_plan",
     "compiler",
     "hbm_budget_bytes",
+    "ledger_readiness",
     "load_history",
     "model",
     "plan_backward_passes",
@@ -79,4 +86,6 @@ __all__ = [
     "price_colpass_candidates",
     "projected_column_bytes",
     "projected_request_bytes",
+    "refit_from_ledger",
+    "stamp_measured_wall",
 ]

@@ -62,9 +62,29 @@ __all__ = [
     "plan_delta",
     "plan_mesh_layout",
     "price_cache_tier",
+    "stamp_measured_wall",
 ]
 
 PLAN_SCHEMA = "swiftly-tpu-plan/1"
+
+
+def stamp_measured_wall(block, measured_wall_s):
+    """Close a stamped plan block with its measured wall.
+
+    Sig-fig rounding, not decimal: ``round(x, 4)`` zeroed sub-0.1 ms
+    smoke-scale legs and the falsy ``0.0`` then silently dropped the
+    ratio — bench_compare skipped the leg as non-comparable. The ratio
+    (predicted / measured) is emitted whenever both walls are genuinely
+    positive. Shared by `Plan.artifact_block` and bench's leg close.
+    """
+    from ..obs.ledger import round_sig
+
+    measured = float(measured_wall_s)
+    block["measured_wall_s"] = round_sig(measured)
+    pred = (block.get("predicted") or {}).get("wall_s") or 0
+    if pred > 0 and measured > 0:
+        block["predicted_vs_measured"] = round_sig(pred / measured)
+    return block
 
 # Fold groups the measured-coefficient search ranks (the seed default 2
 # is always among them; larger groups trade dispatch count against the
@@ -405,12 +425,7 @@ class Plan:
             "alternatives": list(self.alternatives),
         }
         if measured_wall_s is not None:
-            block["measured_wall_s"] = round(float(measured_wall_s), 4)
-            pred = self.predicted.get("wall_s") or 0
-            if pred and measured_wall_s:
-                block["predicted_vs_measured"] = round(
-                    pred / measured_wall_s, 3
-                )
+            stamp_measured_wall(block, measured_wall_s)
         return block
 
     def explain(self):
@@ -765,7 +780,7 @@ def compile_plan(
         )
         if best is None or cand[0] < best[0]:
             best = cand
-    if coeffs.source == "measured" and mode == "roundtrip-streamed":
+    if coeffs.calibrated and mode == "roundtrip-streamed":
         (_wall, fold_group, parts, resident, feed_group, predicted,
          chosen_alt) = best
     else:

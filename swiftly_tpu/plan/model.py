@@ -391,12 +391,15 @@ _DEFAULT_DISPATCH_S = 0.1
 class CostCoefficients:
     """Per-stage throughput coefficients the wall model divides by.
 
-    ``source`` records pedigree: ``"default"`` (static anchors above) or
-    ``"measured"`` (refit from artifact history by `plan.autotune`).
-    The compiler only lets MEASURED coefficients change plan parameters;
-    defaults rank alternatives but the seed heuristics keep the choice,
-    so seed-geometry plans stay provably equivalent to the pre-plan
-    forks.
+    ``source`` records pedigree: ``"default"`` (static anchors above),
+    ``"measured"`` (refit from raw artifact telemetry by
+    `plan.autotune.refit`) or ``"ledger"`` (fit from the accumulated
+    ``plan_accuracy`` calibration history by
+    `plan.autotune.refit_from_ledger`). The compiler only lets
+    CALIBRATED coefficients (`calibrated` — measured or ledger) change
+    plan parameters; defaults rank alternatives but the seed heuristics
+    keep the choice, so seed-geometry plans stay provably equivalent to
+    the pre-plan forks.
     """
 
     flops_per_s: dict = field(default_factory=dict)
@@ -410,6 +413,12 @@ class CostCoefficients:
     # a recorded pallas run exists; surfaced by `scripts/plan_explain.py
     # --colpass` for export as SWIFTLY_COLPASS_BM/BN/BK/SBLOCK
     colpass_blocks: dict | None = None
+
+    @property
+    def calibrated(self):
+        """Measurement-backed pedigree — what unlocks plan parameter
+        selection in `compiler.compile_plan`."""
+        return self.source in ("measured", "ledger")
 
     def flops_rate(self, stage):
         for key in (stage, stage.split(".")[0]):
