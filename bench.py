@@ -2492,10 +2492,17 @@ def mesh_bench(smoke_mode=False):
     device — and stamps a ``mesh`` artifact block: the executed layout
     (shards, padding), the plan's ICI collective bytes, scaling
     efficiency vs single-chip, the reduction-order match audit
-    (per-facet math is identical; only the forward psum's facet-sum
-    order differs — asserted within BENCH_MESH_TOL, default 5e-5
-    relative, docs/multichip.md), and an HLO audit showing the
-    facet-axis all-reduce in the lowered streamed column pass. The
+    (per-facet math is identical; only the forward collective's
+    facet-sum order differs — asserted within BENCH_MESH_TOL, default
+    5e-5 relative, docs/multichip.md), and an HLO audit showing the
+    facet-axis collective in the lowered streamed column pass: the
+    all-reduce under psum, the 2(n-1) collective-permute pipeline under
+    SWIFTLY_MESH_COLLECTIVE=ring. The executed collective is stamped
+    in the artifact and must MATCH the planned one
+    (``plan_compiled.mesh.collective``); under ring the leg also times
+    a psum baseline on the same geometry and records the ring-vs-psum
+    wall ratio. Both paths are warmed (compile + first dispatch)
+    before timing — BENCH_MESH_WARM=0 restores the cold wall. The
     compiled plan's `MeshLayout` is consumed by the engine, so the
     stamped ``plan_compiled.mesh.status`` is ``"bound"``. Validated by
     `obs.validate_mesh_artifact`.
@@ -2610,17 +2617,28 @@ def mesh_bench(smoke_mode=False):
         wall = time.time() - t0
         return np.concatenate(parts, axis=0), wall, spill
 
+    # warm both engines before timing: the first round trip carries
+    # compile + first-dispatch cost, which used to land inside the
+    # mesh wall and skew scaling_efficiency (BENCH_MESH_WARM=0 keeps
+    # the cold wall for compile-cost studies)
+    warm = os.environ.get("BENCH_MESH_WARM", "1") != "0"
+
+    def measured_roundtrip(fwd_exec, make_bwd):
+        if warm:
+            roundtrip(fwd_exec, make_bwd)
+        p0 = _passes_counter()
+        out, wall, spill = roundtrip(fwd_exec, make_bwd)
+        return out, wall, spill, _passes_counter() - p0
+
     # -- single-chip reference (the engine every prior PR measured) ------
     log.info("mesh leg: single-chip reference round trip (%s)", name)
-    passes0 = _passes_counter()
-    ref, wall_single, _spill1 = roundtrip(
+    ref, wall_single, _spill1, single_passes = measured_roundtrip(
         fwd,
         lambda i0, i1: StreamedBackward(
             config, list(facet_configs[i0:i1]), residency="sampled",
             fold_group=fold_group,
         ),
     )
-    single_passes = _passes_counter() - passes0
 
     # -- mesh-streamed run: the compiled layout, bound by the engine -----
     n_shards = min(n_av, F)
@@ -2637,19 +2655,28 @@ def mesh_bench(smoke_mode=False):
     mfwd = MeshStreamedForward(
         config, facet_tasks, layout=plan.mesh, mesh=mesh
     )
+    executed_collective = getattr(mfwd, "collective", "psum")
+    planned_collective = getattr(plan.mesh, "collective", "psum")
+    if executed_collective != planned_collective:
+        problems.append(
+            f"executed collective {executed_collective!r} != planned "
+            f"{planned_collective!r} (plan_compiled.mesh.collective) — "
+            "the env changed between compile and run"
+        )
     log.info(
-        "mesh leg: mesh-streamed round trip over %d shard(s)",
-        mfwd.facet_shards,
+        "mesh leg: mesh-streamed round trip over %d shard(s) (%s)",
+        mfwd.facet_shards, executed_collective,
     )
-    passes0 = _passes_counter()
-    got, wall_mesh, spill2 = roundtrip(
-        mfwd,
-        lambda i0, i1: MeshStreamedBackward(
+
+    def _mesh_bwd(i0, i1):
+        return MeshStreamedBackward(
             config, list(facet_configs[i0:i1]), mesh=mesh,
             fold_group=fold_group,
-        ),
+        )
+
+    got, wall_mesh, spill2, mesh_passes = measured_roundtrip(
+        mfwd, _mesh_bwd
     )
-    mesh_passes = _passes_counter() - passes0
     if mesh_passes != 1:
         problems.append(
             f"mesh round trip ran {mesh_passes} forward pass(es); the "
@@ -2688,11 +2715,49 @@ def mesh_bench(smoke_mode=False):
     )
     hlo = colfn.lower(*probe).compile().as_text()
     n_all_reduce = len(re.findall(r"all-reduce(?:-start)?\(", hlo))
-    if not n_all_reduce:
+    n_permute = len(
+        re.findall(r"collective-permute(?:-start)?\(", hlo)
+    )
+    if executed_collective == "ring":
+        if not n_permute:
+            problems.append(
+                "ring collective requested but no collective-permute "
+                "in the lowered streamed column pass (likely HLO "
+                "text-format drift — see "
+                "__graft_entry__.dryrun_multichip)"
+            )
+    elif not n_all_reduce:
         problems.append(
             "no all-reduce in the lowered streamed column pass (likely "
             "HLO text-format drift — see __graft_entry__.dryrun_multichip)"
         )
+
+    # -- ring-vs-psum baseline: same geometry, blocking collective -------
+    # Recorded whenever ring executed: the overlap claim is a RATIO
+    # claim, so the artifact carries the psum wall it beat (or didn't —
+    # CPU-simulated permutes share one memory bus, so the ratio is a
+    # trend anchor there, meaningful on real ICI like the SE itself).
+    collective_baseline = None
+    if executed_collective == "ring":
+        log.info("mesh leg: psum baseline round trip (same geometry)")
+        prev_env = os.environ.get("SWIFTLY_MESH_COLLECTIVE")
+        os.environ["SWIFTLY_MESH_COLLECTIVE"] = "psum"
+        try:
+            _, wall_psum, _, _ = measured_roundtrip(mfwd, _mesh_bwd)
+        finally:
+            if prev_env is None:
+                del os.environ["SWIFTLY_MESH_COLLECTIVE"]
+            else:
+                os.environ["SWIFTLY_MESH_COLLECTIVE"] = prev_env
+        collective_baseline = {
+            "collective": "psum",
+            "mesh_wall_s": round(wall_psum, 4),
+            "scaling_efficiency": round(
+                (wall_single / wall_psum) / mfwd.facet_shards, 4
+            ),
+            # > 1.0 = ring round trip beat the blocking psum
+            "ring_vs_psum": round(wall_psum / wall_mesh, 4),
+        }
 
     mesh_block = {
         "n_devices": int(n_av),
@@ -2708,6 +2773,7 @@ def mesh_bench(smoke_mode=False):
         "scaling_efficiency": round(
             (wall_single / wall_mesh) / mfwd.facet_shards, 4
         ),
+        "collective": executed_collective,
         "match": {
             "max_abs_diff": max_abs,
             "rms_diff": rms,
@@ -2715,10 +2781,16 @@ def mesh_bench(smoke_mode=False):
             "within_tolerance": bool(max_abs <= tol),
             "bit_identical": bool(max_abs == 0.0),
         },
-        "hlo": {"all_reduce": n_all_reduce, "stage": "fwd.column_pass"},
+        "hlo": {
+            "all_reduce": n_all_reduce,
+            "collective_permute": n_permute,
+            "stage": "fwd.column_pass",
+        },
         "spill": spill2.stats(),
         "forward_passes": mesh_passes,
     }
+    if collective_baseline is not None:
+        mesh_block["collective_baseline"] = collective_baseline
     record = {
         "metric": f"{name} mesh-streamed round-trip wall-clock "
                   f"({len(subgrid_configs)} subgrids, planar f32, "
@@ -2739,7 +2811,8 @@ def mesh_bench(smoke_mode=False):
     )
     record["telemetry"] = metrics.export()
     # per-stage predicted-vs-measured reconciliation — the mesh leg is
-    # where the plan's mesh.psum pricing meets its measured stage
+    # where the plan's collective pricing (mesh.psum / mesh.ring_step)
+    # meets its measured stage
     _stamp_plan_accuracy(record)
     problems.extend(validate_plan_accuracy_artifact(record))
     if trace_path:
@@ -2765,9 +2838,16 @@ def mesh_bench(smoke_mode=False):
                 "config": name,
                 "artifact": out_path,
                 "facet_shards": mesh_block["facet_shards"],
+                "collective": executed_collective,
                 "scaling_efficiency": mesh_block["scaling_efficiency"],
+                **(
+                    {"ring_vs_psum": collective_baseline["ring_vs_psum"]}
+                    if collective_baseline
+                    else {}
+                ),
                 "max_abs_diff": max_abs,
                 "all_reduce": n_all_reduce,
+                "collective_permute": n_permute,
                 "problems": problems,
             }
         ),
@@ -3878,7 +3958,8 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
        the spill cache, pass 2 is cache-fed) — the reference facets,
        with NO fault plan installed.
     2. Watchdog phase: re-run the recording briefly with an injected
-       ``mesh.psum`` latency and a small
+       collective latency (``mesh.psum``, or ``mesh.ring_step`` when
+       SWIFTLY_MESH_COLLECTIVE=ring schedules the pipeline) and a small
        ``SWIFTLY_COLLECTIVE_TIMEOUT_S`` — the stalled collective must
        surface as a caught `CollectiveStalledError` (the silent-hang
        class converted to a detected failure), then is discarded.
@@ -3999,13 +4080,21 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
         ref = np.concatenate(parts, axis=0)
         clean_s = time.time() - t0
 
-        # --- watchdog phase: a stalled psum becomes a DETECTED loss --
+        # --- watchdog phase: a stalled collective is a DETECTED loss --
+        # the fault site tracks the scheduled collective: mesh.psum
+        # under the default, mesh.ring_step when
+        # SWIFTLY_MESH_COLLECTIVE=ring pipelines the reduction
         wd_timeout = float(
             os.environ.get("BENCH_MESH_WATCHDOG_S", "0.15")
         )
+        stall_site = (
+            "mesh.ring_step"
+            if getattr(mfwd, "collective", "psum") == "ring"
+            else "mesh.psum"
+        )
         stall_plan = FaultPlan(
             faults=[
-                {"site": "mesh.psum", "kind": "latency", "at": 0,
+                {"site": stall_site, "kind": "latency", "at": 0,
                  "delay_s": wd_timeout * 4},
             ]
         )
@@ -4108,6 +4197,7 @@ def run_mesh_chaos_drill(config_name, fault_plan=None, col_group=2,
             "watchdog": {
                 "timeout_s": wd_timeout,
                 "stalls_detected": stalls_detected,
+                "stall_site": stall_site,
                 "stall_plan": stall_plan.stats(),
             },
             "kill_site": "mesh.shard_loss",

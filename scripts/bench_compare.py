@@ -35,7 +35,12 @@ artifact) and exits non-zero when a leg regressed:
   ``mesh.scaling_efficiency`` metric (speedup per shard vs the
   single-chip engine, higher is better) more than the threshold below
   the best same-platform reference — multi-chip scaling that quietly
-  decays is a capacity regression even when the single-chip wall holds.
+  decays is a capacity regression even when the single-chip wall
+  holds. Mesh verdicts carry the leg's ``collective`` pedigree
+  (executed ``mesh.collective``, else the compiled prediction) and an
+  SE problem message names it — a regression that is really a silent
+  ring→psum fallback is readable from the verdict alone, exactly like
+  the colpass rule above.
 * **delta speedup** — for incremental-update legs (``--delta``
   artifacts): the ``delta.speedup_vs_full`` metric (full re-record
   wall over patch wall, higher is better) more than the threshold
@@ -417,11 +422,20 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"{100 * (1 - rps / ref['rps']):.1f}% below best "
                     f"reference {ref['rps']:.4g} rps"
                 )
-        # mesh legs: multi-chip scaling sentinel (higher is better)
+        # mesh legs: multi-chip scaling sentinel (higher is better).
+        # Verdicts carry the leg's collective pedigree (executed
+        # mesh.collective, else the compiled prediction) — an SE
+        # regression reads differently when the leg silently fell
+        # back from ring to the blocking psum (the colpass rule).
         se = (rec.get("mesh") or {}).get("scaling_efficiency")
+        collective = (rec.get("mesh") or {}).get("collective") or (
+            (rec.get("plan_compiled") or {}).get("mesh") or {}
+        ).get("collective")
         if isinstance(se, (int, float)) and se > 0:
             verdict["scaling_efficiency"] = se
             verdict["ref_scaling_efficiency"] = ref["se"]
+            if collective is not None:
+                verdict["collective"] = collective
             if (
                 ref["se"] is not None
                 and se < ref["se"] * (1.0 - threshold)
@@ -430,6 +444,11 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"scaling efficiency {se:.4g} is "
                     f"{100 * (1 - se / ref['se']):.1f}% below best "
                     f"reference {ref['se']:.4g}"
+                    + (
+                        f" (collective={collective})"
+                        if collective
+                        else ""
+                    )
                 )
         # delta legs: incremental-update speedup sentinel (higher is
         # better) — degradation toward full-recompute cost

@@ -35,12 +35,19 @@ vs an L2 (spill) read vs a recompute, scanned over candidate L1 sizes
 with the break-even size marked — the fabric's answer to "how big
 should each replica's hot-row cache be".
 
-With ``--devices N`` (N > 1) the report ends with the DEGRADED-LAYOUT
-table: the mesh layout the compiler would re-plan onto after losing a
-shard (N-1 devices) and after losing half the mesh (N/2) — the same
-`plan.plan_mesh_layout` call the elastic recovery ladder makes
-mid-stream (`mesh.recovery`), so an operator can read the post-failure
-shape and per-shard footprint BEFORE a failure forces it.
+With ``--devices N`` (N > 1) the report adds the ranked
+COLLECTIVE-ALTERNATIVE table (`plan.price_collective_candidates`): the
+blocking ``mesh.psum`` all-reduce vs the ``mesh.ring_step`` ppermute
+pipeline, each with its cover bytes, step count, per-step chunk, and
+overlap-discounted predicted wall, the planned schedule marked — the
+same defaults-only-RANK rule as ``--colpass`` (SWIFTLY_MESH_COLLECTIVE
+forces; ``auto`` needs calibrated coefficients to flip off psum). The
+report then ends with the DEGRADED-LAYOUT table: the mesh layout the
+compiler would re-plan onto after losing a shard (N-1 devices) and
+after losing half the mesh (N/2) — the same `plan.plan_mesh_layout`
+call the elastic recovery ladder makes mid-stream (`mesh.recovery`),
+so an operator can read the post-failure shape and per-shard footprint
+BEFORE a failure forces it.
 
 Exit: 0 on a printed plan, 2 on a bad config/inputs.
 """
@@ -276,6 +283,39 @@ def main(argv=None):
     if args.devices > 1:
         from swiftly_tpu.plan import plan_mesh_layout
 
+        cands = plan.mesh.collective_candidates
+        if cands:
+            print()
+            print(
+                f"  collective alternatives over "
+                f"{plan.mesh.facet_shards} shard(s) "
+                f"(planned: {plan.mesh.collective}):"
+            )
+            print(
+                "    rank  collective  coeff stage     bytes/cover  "
+                "steps  chunk/step    GB/s  overlap  predicted wall"
+            )
+            for i, row in enumerate(cands):
+                mark = (
+                    "  <- planned"
+                    if row["collective"] == plan.mesh.collective
+                    else ""
+                )
+                print(
+                    f"    {i + 1:4d}  {row['collective']:10s}  "
+                    f"{row['coeff_stage']:14s}  "
+                    f"{row['bytes'] / 2 ** 30:7.2f} GiB  "
+                    f"{row['steps']:5d}  "
+                    f"{row['chunk_bytes'] / 2 ** 20:6.1f} MiB  "
+                    f"{row['bytes_per_s'] / 1e9:6.0f}  "
+                    f"{row['overlap_discount']:7.2f}  "
+                    f"{row['predicted_wall_s']:10.4f} s{mark}"
+                )
+            print(
+                "    note: the table only RANKS — "
+                "SWIFTLY_MESH_COLLECTIVE forces the schedule, auto "
+                "needs calibrated coefficients to flip off psum"
+            )
         print()
         print(
             "  degraded layouts (what the elastic recovery ladder "

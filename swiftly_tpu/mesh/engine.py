@@ -35,14 +35,22 @@ turns those pieces into a mesh-streamed ENGINE:
   plan's ``backward.feed_group`` sizes the chunk; ``bench.py --mesh``
   routes both its single-chip reference and the mesh run through it).
 * Elastic recovery surface: the engines carry the mesh-path fault
-  sites (``mesh.psum`` on the host sync downstream of the column
-  psum — watchdog-wrapped when ``SWIFTLY_COLLECTIVE_TIMEOUT_S`` is
-  set, so a stalled collective raises instead of hanging;
-  ``mesh.shard_loss`` once per yielded forward group; ``mesh.feed``
-  per backward group feed) and a ``rebuild_on(mesh, layout)`` hook
-  that re-constructs the same engine on a SURVIVOR mesh —
-  `mesh.recovery` drives detect → re-plan → migrate → resume over
-  these (docs/resilience.md).
+  sites (``mesh.psum`` / ``mesh.ring_step`` — whichever schedule the
+  column-group sync is draining — on the host sync downstream of the
+  column collective, watchdog-wrapped when
+  ``SWIFTLY_COLLECTIVE_TIMEOUT_S`` is set, so a stalled collective
+  raises instead of hanging; ``mesh.shard_loss`` once per yielded
+  forward group; ``mesh.feed`` per backward group feed) and a
+  ``rebuild_on(mesh, layout)`` hook that re-constructs the same engine
+  on a SURVIVOR mesh — `mesh.recovery` drives detect → re-plan →
+  migrate → resume over these (docs/resilience.md), with the ring
+  schedule re-resolved for the survivor shard count on rebuild.
+* The collective schedule itself is selectable:
+  ``SWIFTLY_MESH_COLLECTIVE={psum,ring,auto}`` picks between the
+  blocking per-group `lax.psum` and the `ppermute` ring
+  (`parallel.sharded.ring_allreduce`) whose chunk rotations hide
+  behind the next group's shard-local contraction and h2d staging
+  fill (docs/multichip.md "Collective schedules").
 
 Exactness contract: per-facet math is byte-identical to the single-chip
 engine (the shard_map bodies are built from the same ``*_fn`` builders);
@@ -78,6 +86,7 @@ from ..parallel.mesh import (
     make_facet_mesh,
     mesh_size,
     pad_to_shards,
+    resolve_collective,
 )
 from ..parallel.streamed import StreamedBackward, StreamedForward
 from ..resilience.faults import fault_point as _fault_point
@@ -236,14 +245,26 @@ class MeshStreamedForward(StreamedForward):
     def facet_shards(self):
         return mesh_size(self.mesh)
 
+    @property
+    def collective(self):
+        """The facet-axis reduction schedule the NEXT dispatch runs
+        (``psum`` or ``ring``) — resolved from SWIFTLY_MESH_COLLECTIVE
+        at read time, exactly like the compiled kernels resolve it at
+        call time, so the recorded pedigree always names the executed
+        schedule."""
+        return resolve_collective(self.facet_shards)
+
     def rebuild_on(self, mesh, layout=None):
         """A fresh engine of the SAME construction on a different mesh.
 
         The elastic recovery hook: after a shard loss, `mesh.recovery`
         re-plans the layout on the survivors and rebuilds the engines
-        here — same config/facets/blocking, new fabric. The original
-        engine is left untouched (its devices may be gone; nothing is
-        torn down through them)."""
+        here — same config/facets/blocking, new fabric (the ring
+        schedule, when selected, re-resolves for the survivor shard
+        count on the next dispatch — its step count is n-1, so the
+        re-planned collective is automatically right-sized). The
+        original engine is left untouched (its devices may be gone;
+        nothing is torn down through them)."""
         return type(self)(mesh=mesh, layout=layout, **self._rebuild_kw)
 
     def stream_column_groups(self, subgrid_configs, spill=None):
@@ -265,6 +286,7 @@ class MeshStreamedForward(StreamedForward):
             "axis": FACET_AXIS,
             "n_facets": int(self.stack.n_real),
             "padded_facets": int(self.stack.n_total),
+            "collective": self.collective,
         }
 
     def _spill_store(self, spill, per_col, out_g):
@@ -273,25 +295,41 @@ class MeshStreamedForward(StreamedForward):
         spill fill never addresses another host's devices.
 
         This host pull is the first point the stream BLOCKS on the
-        column group's psum completing, which makes it the engine's
-        stall-detection site: the sync runs through the ``mesh.psum``
+        column group's collective completing, which makes it the
+        engine's stall-detection site: the sync runs through the
+        ``mesh.psum`` (or, under the ring schedule, ``mesh.ring_step``)
         fault point under the collective watchdog
         (``SWIFTLY_COLLECTIVE_TIMEOUT_S``), so a collective hung on a
         dead peer raises `CollectiveStalledError` — a catchable shard
-        loss — instead of blocking the host forever."""
+        loss — instead of blocking the host forever.
+
+        Overlap semantics: `stream_column_groups` stores one group
+        BEHIND compute (group g's sync runs after group g+1's dispatch)
+        and the triple-buffer prefetch thread is already filling group
+        g+1's staging slab while this sync waits — so under the ring
+        schedule the final `ppermute` steps of group g drain behind
+        both the next group's shard-local contraction and its h2d feed
+        (the communication-overlap contract; docs/multichip.md)."""
         if spill.gave_up:
             return
+        # resolved per group: the site must name the schedule the
+        # devices are actually draining (psum and ring are separately
+        # priced, separately watched, separately chaos-drilled)
+        site = (
+            "mesh.ring_step" if self.collective == "ring" else "mesh.psum"
+        )
 
         def pull():
             _fault_point("transfer.d2h")
 
             def sync():
-                _fault_point("mesh.psum")
-                # split the block: the wait on the group's psum is the
-                # plan's mesh.psum ICI stage, the host copy after it is
-                # spill.write — timed apart so the plan-accuracy ledger
-                # (obs.ledger) joins each against its own priced stage
-                with _metrics.stage("mesh.psum") as st:
+                _fault_point(site)
+                # split the block: the wait on the group's collective is
+                # the plan's ICI stage (mesh.psum / mesh.ring_step), the
+                # host copy after it is spill.write — timed apart so the
+                # plan-accuracy ledger (obs.ledger) joins each against
+                # its own priced stage
+                with _metrics.stage(site) as st:
                     if hasattr(out_g, "block_until_ready"):
                         out_g.block_until_ready()
                         st.bytes_moved = int(getattr(out_g, "nbytes", 0))
@@ -300,7 +338,7 @@ class MeshStreamedForward(StreamedForward):
                     st.bytes_moved = int(arr.nbytes)
                 return arr
 
-            return _watch(sync, "mesh.psum")
+            return _watch(sync, site)
 
         host = _retry(pull, site="transfer.d2h")
         if spill.put(per_col, host) and _metrics.enabled():

@@ -183,10 +183,13 @@ def run_elastic_pass(forward, backward, subgrid_configs, spill,
     into ``backward.add_subgrid_group`` with per-group autosave to
     `ckpt_path`. A `ShardLostError` anywhere in the loop (an injected
     ``mesh.shard_loss``/``mesh.feed`` fault, or the watchdog's
-    `CollectiveStalledError` from a stalled ``mesh.psum``) triggers
-    `recover_engines`; the pass resumes on the rebuilt engines at the
-    last autosave boundary, skipping fully-processed groups — the same
-    skip discipline as the PR-4 kill-and-resume drill.
+    `CollectiveStalledError` from a stalled ``mesh.psum`` or
+    ``mesh.ring_step``) triggers `recover_engines`; the pass resumes on
+    the rebuilt engines at the last autosave boundary, skipping
+    fully-processed groups — the same skip discipline as the PR-4
+    kill-and-resume drill. The rebuilt layout re-resolves the
+    collective for the survivor shard count (a 2-shard survivor ring is
+    a different pipeline than the 8-shard original).
 
     Returns ``(forward', backward', report)``: the (possibly rebuilt)
     engines — the backward with the pass fully folded in (callers

@@ -99,6 +99,7 @@ PLAN_STAGE_TIMERS = {
     "bwd.feed_group": ("bwd.feed_group",),
     "fwd.replay": ("fwd.replay",),
     "mesh.psum": ("mesh.psum",),
+    "mesh.ring_step": ("mesh.ring_step",),
 }
 
 # Runtime timers deliberately OUTSIDE the priced model, each with its

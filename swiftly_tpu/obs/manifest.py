@@ -812,7 +812,17 @@ def validate_mesh_artifact(record):
             )
     hlo = mesh.get("hlo")
     if isinstance(hlo, dict):
-        if not hlo.get("all_reduce"):
+        # the HLO audit proves the EXECUTED collective matches the
+        # schedule: psum lowers to a facet-axis all-reduce, ring to the
+        # 2(n-1) collective-permute pipeline (and must NOT silently
+        # fall back to all-reduce)
+        if mesh.get("collective") == "ring":
+            if not hlo.get("collective_permute"):
+                problems.append(
+                    "ring collective requested but lowered streamed "
+                    "stage shows no collective-permute pipeline"
+                )
+        elif not hlo.get("all_reduce"):
             problems.append(
                 "lowered streamed stage shows no facet-axis all-reduce"
             )

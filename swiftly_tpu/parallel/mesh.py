@@ -8,13 +8,18 @@ collectives (psum over ICI within a slice, DCN across slices).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 FACET_AXIS = "facet"
 
+COLLECTIVES = ("psum", "ring")
+
 __all__ = [
+    "COLLECTIVES",
     "FACET_AXIS",
     "facet_sharding",
     "mesh_size",
@@ -23,7 +28,44 @@ __all__ = [
     "make_facet_mesh",
     "pad_to_shards",
     "replicated_sharding",
+    "resolve_collective",
 ]
+
+
+def resolve_collective(n_shards: int | None = None) -> str:
+    """The facet-axis reduction schedule a sharded column pass runs.
+
+    ``SWIFTLY_MESH_COLLECTIVE`` ∈ {psum, ring, auto} (default auto):
+
+    - ``psum`` — one blocking ``lax.psum`` per column group; XLA lowers
+      it to its own all-reduce.  Deterministic tree order, the exactness
+      reference.
+    - ``ring`` — reduce-scatter + all-gather built from 2(n−1)
+      ``lax.ppermute`` chunk rotations, so each step moves 1/n of the
+      buffer and the schedule interleaves with neighbouring compute
+      instead of serializing after it.  Same result up to reduction
+      order (documented tolerance in docs/multichip.md).
+    - ``auto`` — psum.  The conservative default: the ring only wins
+      when its measured ``mesh.ring_step`` rate says so, and that
+      decision lives in the plan compiler (calibrated-coefficient gated,
+      like the colpass candidates); the engine follows the plan by
+      exporting the choice through this env knob, not by guessing here.
+
+    Read at CALL time (not trace time) so one process can bench psum and
+    ring back to back; the sharded kernel caches key on the resolved
+    value.  A one-shard "mesh" always degrades to psum — there is no
+    ring of one.
+    """
+    mode = os.environ.get("SWIFTLY_MESH_COLLECTIVE", "auto")
+    if mode not in ("psum", "ring", "auto"):
+        raise ValueError(
+            f"SWIFTLY_MESH_COLLECTIVE must be psum|ring|auto, got {mode!r}"
+        )
+    if n_shards is not None and n_shards <= 1:
+        return "psum"
+    if mode == "auto":
+        return "psum"
+    return mode
 
 
 def make_facet_mesh(n_devices: int | None = None, devices=None) -> Mesh:

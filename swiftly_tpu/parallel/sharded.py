@@ -15,6 +15,12 @@ Forward (`subgrid_from_columns_sharded`):
                                             one xM x xM buffer per subgrid]
   replicated: finish (iFFT + crop) + masks
 
+The facet-axis reduction itself has two schedules (SWIFTLY_MESH_COLLECTIVE):
+the blocking `lax.psum` above, or `ring_allreduce` — a reduce-scatter +
+all-gather built from 2(n-1) `lax.ppermute` chunk rotations whose steps
+overlap neighbouring compute instead of fencing it (same sum up to
+reduction order; see docs/multichip.md "Collective schedules").
+
 Backward (`split_subgrid_sharded`):
   replicated: prepare_subgrid (pad + FFT) on every device
   per-device: vmap extract -> facet-sharded NAF_NAFs  [traffic: the xA x xA
@@ -52,7 +58,94 @@ from .batched import (
     finish_masked_subgrid,
     subgrid_contrib_to_facet,
 )
-from .mesh import FACET_AXIS, varying
+from .mesh import FACET_AXIS, mesh_size, resolve_collective, varying
+
+
+def ring_allreduce(x, axis_name: str, n_shards: int | None = None):
+    """Facet-axis all-reduce as a `ppermute` ring: reduce-scatter then
+    all-gather, 2(n-1) neighbour rotations of a 1/n-size chunk.
+
+    The buffer is flattened and split into n equal chunks (zero-padded to
+    a multiple of n — exact, the pad never aliases real elements). Each
+    shard owns one chunk's running sum; every reduce-scatter step rotates
+    the partial one hop around the ring and folds in the local copy of
+    the chunk now in flight, so after n-1 steps shard i holds the fully
+    reduced chunk (i+1) % n. The all-gather phase rotates the finished
+    chunks the rest of the way around. Per-step traffic is size/n vs the
+    whole buffer for a blocking psum, and each step's `ppermute` has no
+    data dependence on neighbouring column contractions — XLA is free to
+    run the rotation concurrently with the next facet block's local
+    einsum (the overlap the mesh engine's triple-buffer feed completes).
+
+    Exactness: every shard accumulates each chunk in the SAME ring
+    order, so the result is deterministic and shard-count-reproducible,
+    but the reduction ORDER differs from psum's tree — expect float
+    rounding drift within the documented tolerance (docs/multichip.md),
+    not bit-identity. Zero-padded facet shards (9-over-8 cover) add
+    exact zeros, so padding never widens the drift.
+    """
+    n = int(n_shards) if n_shards is not None else jax.lax.psum(1, axis_name)
+    if n <= 1:
+        return x
+    idx = jax.lax.axis_index(axis_name)
+    flat = x.reshape(-1)
+    per = -(-flat.size // n)
+    pad = n * per - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    parts = flat.reshape(n, per)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def chunk(k):
+        return jax.lax.dynamic_index_in_dim(parts, k % n, 0, keepdims=False)
+
+    with jax.named_scope("swiftly/mesh.ring_step"):
+        acc = chunk(idx)
+        for s in range(1, n):  # reduce-scatter
+            acc = jax.lax.ppermute(acc, axis_name, perm)
+            acc = acc + chunk(idx - s)
+        own = (idx + 1) % n  # shard i finishes chunk (i+1) % n
+        gathered = jnp.zeros((n, per), acc.dtype)
+        gathered = jax.lax.dynamic_update_index_in_dim(gathered, acc, own, 0)
+        cur = acc
+        for s in range(1, n):  # all-gather
+            cur = jax.lax.ppermute(cur, axis_name, perm)
+            gathered = jax.lax.dynamic_update_index_in_dim(
+                gathered, cur, (own - s) % n, 0
+            )
+    out = gathered.reshape(-1)
+    if pad:
+        out = out[: x.size]
+    return out.reshape(x.shape)
+
+
+def collective_sum(x, axis_name: str, collective: str = "psum",
+                   n_shards: int | None = None):
+    """The facet-axis reduction under the selected schedule: blocking
+    `lax.psum` (XLA all-reduce) or the `ppermute` ring."""
+    if collective == "ring":
+        return ring_allreduce(x, axis_name, n_shards)
+    return jax.lax.psum(x, axis_name)
+
+
+def _mapped(fn, mesh, in_specs, out_specs, check_rep: bool = True):
+    """shard_map with an optional check_rep=False escape hatch.
+
+    Ring kernels mix `ppermute`/`axis_index` results into replicated
+    outputs — correct (every shard materialises the same gathered sum)
+    but not provable by the replication checker, so they opt out the
+    same way streamed.py's `_shmap` does. psum kernels keep the check.
+    """
+    if check_rep:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+    except TypeError:  # pragma: no cover - jax without check_rep kwarg
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
 
 def _scoped(name, fn):
     """Wrap a kernel body in ``jax.named_scope`` so its compiled HLO ops
@@ -68,7 +161,9 @@ def _scoped(name, fn):
 
 __all__ = [
     "backward_all_sharded",
+    "collective_sum",
     "forward_all_sharded",
+    "ring_allreduce",
     "split_accumulate_sharded",
     "split_subgrid_sharded",
     "subgrid_from_columns_sharded",
@@ -80,8 +175,10 @@ __all__ = [
 # every (core, mesh) pair's compiled executable forever. Evicted kernels
 # simply recompile on next use.
 @functools.lru_cache(maxsize=32)
-def _forward_kernel(core, mesh, subgrid_size: int):
-    """Build the jitted shard_map program for one (core, mesh, size)."""
+def _forward_kernel(core, mesh, subgrid_size: int, collective: str = "psum"):
+    """Build the jitted shard_map program for one (core, mesh, size,
+    collective)."""
+    n_shards = mesh_size(mesh)
 
     def body(NMBF_BFs, offs0, offs1, sg_offs, mask0, mask1):
         contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
@@ -89,16 +186,17 @@ def _forward_kernel(core, mesh, subgrid_size: int):
         )
         # Local reduction over this shard's facets, then one all-reduce.
         local = jnp.sum(jax.vmap(contrib)(NMBF_BFs, offs0, offs1), axis=0)
-        summed = jax.lax.psum(local, FACET_AXIS)
+        summed = collective_sum(local, FACET_AXIS, collective, n_shards)
         return finish_masked_subgrid(
             core, summed, sg_offs, subgrid_size, mask0, mask1
         )
 
-    mapped = _shard_map(
+    mapped = _mapped(
         _scoped("swiftly/fwd.column_pass", body),
         mesh=mesh,
         in_specs=(P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P()),
         out_specs=P(),
+        check_rep=collective != "ring",
     )
     return jax.jit(mapped)
 
@@ -109,9 +207,13 @@ def subgrid_from_columns_sharded(
     """Facet-sharded NMBF_BFs [F, m, yN] -> replicated subgrid [xA, xA].
 
     Same contract as ``batched.subgrid_from_columns_batch`` but with the
-    facet reduction expressed as an explicit ``lax.psum`` over the mesh.
+    facet reduction expressed as an explicit collective over the mesh
+    (``lax.psum`` or the `ppermute` ring, per SWIFTLY_MESH_COLLECTIVE —
+    resolved at call time so psum and ring can run in one process).
     """
-    fn = _forward_kernel(core, mesh, subgrid_size)
+    fn = _forward_kernel(
+        core, mesh, subgrid_size, resolve_collective(mesh_size(mesh))
+    )
     rdt = core._Fb.dtype
     return fn(
         NMBF_BFs,
@@ -173,10 +275,11 @@ def split_subgrid_sharded(
 
 
 def _column_partial_then_finish(core, cols, offs0, offs1, off0, col_sg_offs1,
-                                col_m0, col_m1, subgrid_size):
-    """Local facet reduction for all S subgrids of one column, one psum,
-    then the (replicated) finishes. Shared by the column and whole-cover
-    kernels."""
+                                col_m0, col_m1, subgrid_size,
+                                collective="psum", n_shards=None):
+    """Local facet reduction for all S subgrids of one column, one
+    collective, then the (replicated) finishes. Shared by the column and
+    whole-cover kernels."""
 
     def partial_sg(off1):
         contrib = lambda NMBF_BF, foff0, foff1: facet_contrib_to_subgrid(
@@ -185,7 +288,8 @@ def _column_partial_then_finish(core, cols, offs0, offs1, off0, col_sg_offs1,
         return jnp.sum(jax.vmap(contrib)(cols, offs0, offs1), axis=0)
 
     partial = jax.vmap(partial_sg)(col_sg_offs1)  # [S, xM, xM] local
-    summed = jax.lax.psum(partial, FACET_AXIS)  # one collective per column
+    # one collective per column: blocking all-reduce or ppermute ring
+    summed = collective_sum(partial, FACET_AXIS, collective, n_shards)
 
     def fin(s, off1, m0, m1):
         return finish_masked_subgrid(
@@ -196,22 +300,26 @@ def _column_partial_then_finish(core, cols, offs0, offs1, off0, col_sg_offs1,
 
 
 @functools.lru_cache(maxsize=32)
-def _forward_column_kernel(core, mesh, subgrid_size: int):
-    """One column's S subgrids in one program: single psum per column."""
+def _forward_column_kernel(core, mesh, subgrid_size: int,
+                           collective: str = "psum"):
+    """One column's S subgrids in one program: single collective per
+    column (all-reduce or ppermute ring)."""
+    n_shards = mesh_size(mesh)
 
     def body(NMBF_BFs, offs0, offs1, off0, sg_offs1, masks0, masks1):
         return _column_partial_then_finish(
             core, NMBF_BFs, offs0, offs1, off0, sg_offs1, masks0, masks1,
-            subgrid_size,
+            subgrid_size, collective, n_shards,
         )
 
-    mapped = _shard_map(
+    mapped = _mapped(
         _scoped("swiftly/fwd.column_pass", body),
         mesh=mesh,
         in_specs=(
             P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
         ),
         out_specs=P(),
+        check_rep=collective != "ring",
     )
     return jax.jit(mapped)
 
@@ -222,9 +330,11 @@ def subgrids_from_columns_sharded(
     """All subgrids of one column on the mesh: [S, xA, xA], one dispatch.
 
     Mesh analogue of ``batched.subgrids_from_columns_batch``: local facet
-    reduction + a single psum for the whole stacked column.
+    reduction + a single collective for the whole stacked column.
     """
-    fn = _forward_column_kernel(core, mesh, subgrid_size)
+    fn = _forward_column_kernel(
+        core, mesh, subgrid_size, resolve_collective(mesh_size(mesh))
+    )
     rdt = core._Fb.dtype
     return fn(
         NMBF_BFs,
@@ -238,14 +348,20 @@ def subgrids_from_columns_sharded(
 
 
 @functools.lru_cache(maxsize=32)
-def _forward_all_kernel(core, mesh, subgrid_size: int):
+def _forward_all_kernel(core, mesh, subgrid_size: int,
+                        collective: str = "psum"):
     """The whole forward cover as ONE shard_map program.
 
     Scan over columns; per column: extract the local facets' column
-    blocks, reduce their contributions for all S subgrids, one psum,
-    finish. O(1) dispatches and O(columns) collectives for the entire
-    transform — the mesh analogue of ``batched.forward_all_batch``.
+    blocks, reduce their contributions for all S subgrids, one
+    collective, finish. O(1) dispatches and O(columns) collectives for
+    the entire transform — the mesh analogue of
+    ``batched.forward_all_batch``. Under the ring schedule the scanned
+    column's `ppermute` rotations carry no dependence on the next
+    column's extraction/contraction, so the rotation overlaps the next
+    column's local work instead of fencing it.
     """
+    n_shards = mesh_size(mesh)
 
     def body(BF_Fs, offs0, offs1, col_offs0, sg_offs1, masks0, masks1):
         def one_column(_, xs):
@@ -253,7 +369,7 @@ def _forward_all_kernel(core, mesh, subgrid_size: int):
             cols = _extract_columns_fn(core, BF_Fs, off0, offs1)
             return None, _column_partial_then_finish(
                 core, cols, offs0, offs1, off0, col_sg_offs1, col_m0,
-                col_m1, subgrid_size,
+                col_m1, subgrid_size, collective, n_shards,
             )
 
         _, subgrids = jax.lax.scan(
@@ -261,13 +377,14 @@ def _forward_all_kernel(core, mesh, subgrid_size: int):
         )
         return subgrids
 
-    mapped = _shard_map(
+    mapped = _mapped(
         _scoped("swiftly/fwd.fused_forward", body),
         mesh=mesh,
         in_specs=(
             P(FACET_AXIS), P(FACET_AXIS), P(FACET_AXIS), P(), P(), P(), P(),
         ),
         out_specs=P(),
+        check_rep=collective != "ring",
     )
     return jax.jit(mapped)
 
@@ -279,9 +396,11 @@ def forward_all_sharded(
     """The full forward cover on the mesh: [C, S, xA, xA], one dispatch.
 
     Same contract as ``batched.forward_all_batch`` with the facet
-    reduction as one explicit psum per scanned column.
+    reduction as one explicit collective per scanned column.
     """
-    fn = _forward_all_kernel(core, mesh, subgrid_size)
+    fn = _forward_all_kernel(
+        core, mesh, subgrid_size, resolve_collective(mesh_size(mesh))
+    )
     rdt = core._Fb.dtype
     return fn(
         BF_Fs,

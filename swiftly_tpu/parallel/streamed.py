@@ -134,7 +134,13 @@ try:  # jax >= 0.6 exports shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map  # noqa: E402
 
-from .mesh import FACET_AXIS, mesh_size as _mesh_size, varying  # noqa: E402
+from .mesh import (  # noqa: E402
+    FACET_AXIS,
+    mesh_size as _mesh_size,
+    resolve_collective as _resolve_collective_env,
+    varying,
+)
+from .sharded import collective_sum as _collective_sum  # noqa: E402
 
 from ..obs import metrics as _metrics  # noqa: E402
 from ..obs import trace as _trace  # noqa: E402
@@ -352,12 +358,57 @@ def _crop_masked_subgrid(core, P, sg_offs, subgrid_size, mask0, mask1):
     return _mask_along(p, out, mask1, 1)
 
 
+def _blocked_collective(block, sg_offs, axis_name, collective, n_shards):
+    """Run the Sb-blocked per-column contraction and reduce the facet
+    axis. psum: lax.map over blocks, one blocking all-reduce at the end
+    (the existing schedule). ring: unrolled block loop with each block
+    ring-reduced the moment its contraction finishes — block k's chunk
+    rotations are data-independent of block k+1's contraction, so XLA
+    schedules the `ppermute` steps concurrently with the next block's
+    local einsum/Pallas work instead of fencing after all of them."""
+    import jax.numpy as jnp
+
+    S = sg_offs.shape[0]
+    Sb = min(_colpass_sblock(), S)
+    nb = -(-S // Sb)
+    Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
+    if nb == 1:
+        P = block(sg_offs)
+        if axis_name is not None:
+            P = _collective_sum(P, axis_name, collective, n_shards)
+        return P
+    pad = nb * Sb - S
+    so_p = (
+        jnp.concatenate([sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)])
+        if pad
+        else sg_offs
+    )
+    so_b = so_p.reshape((nb, Sb) + so_p.shape[1:])
+    if axis_name is not None and collective == "ring":
+        parts = [
+            _collective_sum(block(so_b[i]), axis_name, "ring", n_shards)
+            for i in range(nb)
+        ]
+        return jnp.concatenate(parts, axis=0)[:S]
+    P = jax.lax.map(block, so_b)
+    P = P.reshape((nb * Sb,) + P.shape[2:])[:S]
+    if axis_name is not None:
+        P = _collective_sum(P, axis_name, collective, n_shards)
+    return P
+
+
 def _colpass_einsum_body(
     core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0, masks1,
-    axis_name=None, finish=True,
+    axis_name=None, finish=True, collective="psum", n_shards=None,
 ):
     """One column through the einsum column pass, with prebuilt `ops`
     (so group callers hoist the operator build out of their column loop).
+
+    ``collective`` picks the facet-axis reduction: one blocking psum per
+    column, or the `ppermute` ring — multi-block columns ring-reduce
+    each Sb block as soon as its contraction finishes (unrolled loop
+    instead of lax.map), so block k's chunk rotation overlaps block
+    k+1's local einsum in the emitted schedule.
     """
     import jax.numpy as jnp
 
@@ -380,23 +431,7 @@ def _colpass_einsum_body(
         X = jax.vmap(gather)(so_blk)  # [Sb, F, xM, m(,2)]
         return _ceinsum(core, "sfaj,fjb->sab", X, B1)  # [Sb, xM, xM(,2)]
 
-    S = sg_offs.shape[0]
-    Sb = min(_colpass_sblock(), S)
-    nb = -(-S // Sb)
-    Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
-    if nb == 1:
-        P = block(sg_offs)
-    else:
-        pad = nb * Sb - S
-        so_p = (
-            jnp.concatenate([sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)])
-            if pad
-            else sg_offs
-        )
-        P = jax.lax.map(block, so_p.reshape((nb, Sb) + so_p.shape[1:]))
-        P = P.reshape((nb * Sb,) + P.shape[2:])[:S]
-    if axis_name is not None:
-        P = jax.lax.psum(P, axis_name)
+    P = _blocked_collective(block, sg_offs, axis_name, collective, n_shards)
     if not finish:
         return P
 
@@ -408,7 +443,8 @@ def _colpass_einsum_body(
 
 def _colpass_pallas_body(
     core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0, masks1,
-    axis_name=None, finish=True, interpret=None,
+    axis_name=None, finish=True, interpret=None, collective="psum",
+    n_shards=None,
 ):
     """One column through the FUSED Pallas column pass.
 
@@ -457,23 +493,7 @@ def _colpass_pallas_body(
         )
         return jnp.stack([Pr, Pi], axis=-1)  # [Sb, xM, xM, 2]
 
-    S = sg_offs.shape[0]
-    Sb = min(_colpass_sblock(), S)
-    nb = -(-S // Sb)
-    Sb = -(-S // nb)  # rebalanced: pad < nb, never a near-full block
-    if nb == 1:
-        P = block(sg_offs)
-    else:
-        pad = nb * Sb - S
-        so_p = (
-            jnp.concatenate([sg_offs, jnp.repeat(sg_offs[-1:], pad, 0)])
-            if pad
-            else sg_offs
-        )
-        P = jax.lax.map(block, so_p.reshape((nb, Sb) + so_p.shape[1:]))
-        P = P.reshape((nb * Sb,) + P.shape[2:])[:S]
-    if axis_name is not None:
-        P = jax.lax.psum(P, axis_name)
+    P = _blocked_collective(block, sg_offs, axis_name, collective, n_shards)
     if not finish:
         return P
 
@@ -483,29 +503,32 @@ def _colpass_pallas_body(
     return jax.vmap(fin)(P, sg_offs, masks0, masks1)
 
 
-def _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name=None, finish=True):
+def _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name=None,
+                               finish=True, collective="psum", n_shards=None):
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
         ops = _colpass_operators(core, foffs0, foffs1)
         return _colpass_einsum_body(
             core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0,
-            masks1, axis_name, finish,
+            masks1, axis_name, finish, collective, n_shards,
         )
 
     return fn
 
 
-def _column_pass_fwd_pallas_fn(core, subgrid_size, axis_name=None, finish=True):
+def _column_pass_fwd_pallas_fn(core, subgrid_size, axis_name=None,
+                               finish=True, collective="psum", n_shards=None):
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
         ops = _colpass_operators(core, foffs0, foffs1)
         return _colpass_pallas_body(
             core, subgrid_size, ops, NMBF, foffs1, sg_offs, masks0,
-            masks1, axis_name, finish,
+            masks1, axis_name, finish, None, collective, n_shards,
         )
 
     return fn
 
 
-def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
+def _column_pass_fwd_fn(core, subgrid_size, axis_name=None,
+                        collective="psum", n_shards=None):
     """NMBF column [F, m, yB] -> the column's subgrids [S, xA, xA].
 
     Trace-time dispatcher: the fused Pallas kernel or the
@@ -517,9 +540,15 @@ def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     with the matching group finish.
     """
     bodies = {
-        "einsum": _column_pass_fwd_einsum_fn(core, subgrid_size, axis_name),
-        "pallas": _column_pass_fwd_pallas_fn(core, subgrid_size, axis_name),
-        "fft": _column_pass_fwd_fft_fn(core, subgrid_size, axis_name),
+        "einsum": _column_pass_fwd_einsum_fn(
+            core, subgrid_size, axis_name, True, collective, n_shards
+        ),
+        "pallas": _column_pass_fwd_pallas_fn(
+            core, subgrid_size, axis_name, True, collective, n_shards
+        ),
+        "fft": _column_pass_fwd_fft_fn(
+            core, subgrid_size, axis_name, True, collective, n_shards
+        ),
     }
 
     def fn(NMBF, foffs0, foffs1, sg_offs, masks0=None, masks1=None):
@@ -529,15 +558,16 @@ def _column_pass_fwd_fn(core, subgrid_size, axis_name=None):
     return fn
 
 
-def _column_pass_fwd_fft_fn(core, subgrid_size, axis_name=None, finish=True):
+def _column_pass_fwd_fft_fn(core, subgrid_size, axis_name=None, finish=True,
+                            collective="psum", n_shards=None):
     """The per-facet fft-chain column pass: the facet reduction is a
     lax.scan accumulating one [S, xM, xM] buffer (each step: one facet's
     contributions to ALL S subgrids, S-batched matmuls) — a
     vmap-over-S-of-sum-over-F materialises every (S, F) contribution
     block at once, which OOMs a 16 GiB chip at the 32k scale. With
     `axis_name`, F is the local facet shard and the reduction finishes
-    with ONE psum over the accumulated partials — the streamed
-    pipeline's only collective.
+    with ONE collective (psum or ppermute ring) over the accumulated
+    partials — the streamed pipeline's only collective.
 
     With ``finish=False`` the PRE-finish GRID-space partials [S, xM, xM]
     are returned (no masks consumed): the facet-slab path accumulates
@@ -571,7 +601,9 @@ def _column_pass_fwd_fft_fn(core, subgrid_size, axis_name=None, finish=True):
             facet_step, init, (NMBF_BF, foffs0, foffs1)
         )
         if axis_name is not None:
-            partials = jax.lax.psum(partials, axis_name)
+            partials = _collective_sum(
+                partials, axis_name, collective, n_shards
+            )
         if not finish:
             return partials
 
@@ -596,11 +628,14 @@ def _column_pass_fwd_j(core, subgrid_size):
 
 
 @functools.lru_cache(maxsize=None)
-def _column_pass_fwd_sharded(core, mesh, subgrid_size):
+def _column_pass_fwd_sharded_cached(core, mesh, subgrid_size, collective):
     return _shmap(
         _scoped(
             "swiftly/fwd.column_pass",
-            _column_pass_fwd_fn(core, subgrid_size, axis_name=FACET_AXIS),
+            _column_pass_fwd_fn(
+                core, subgrid_size, axis_name=FACET_AXIS,
+                collective=collective, n_shards=_mesh_size(mesh),
+            ),
         ),
         mesh,
         in_specs=(
@@ -611,7 +646,19 @@ def _column_pass_fwd_sharded(core, mesh, subgrid_size):
     )
 
 
-def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
+def _column_pass_fwd_sharded(core, mesh, subgrid_size):
+    """The sharded column pass under the CURRENT collective schedule.
+
+    SWIFTLY_MESH_COLLECTIVE is resolved per CALL and keys the compiled-
+    program cache, so one process can bench psum and ring back to back
+    without a stale cached program shadowing the requested schedule."""
+    return _column_pass_fwd_sharded_cached(
+        core, mesh, subgrid_size, _resolve_collective_env(_mesh_size(mesh))
+    )
+
+
+def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None,
+                              collective="psum", n_shards=None):
     """Sampled group buffer [F, G*m, yB] -> subgrids [G, S, xA, xA].
 
     vmaps the column pass over a whole sampled-DFT group: one dispatch
@@ -621,7 +668,9 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
     column alone on v5e).
     """
     m = core.xM_yN_size
-    colfn = _column_pass_fwd_fft_fn(core, subgrid_size, axis_name)
+    colfn = _column_pass_fwd_fft_fn(
+        core, subgrid_size, axis_name, True, collective, n_shards
+    )
 
     def fn(buf, foffs0, foffs1, sg_offs_g, masks0_g, masks1_g):
         F = buf.shape[0]
@@ -635,7 +684,11 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
             # operators hoisted across the group's columns; columns run
             # sequentially (lax.map) — each column's einsums are already
             # MXU-wide, and a G-batched vmap would scale the [F, xM, yN]
-            # H transient by G (OOM at 32k G=9)
+            # H transient by G (OOM at 32k G=9). Under the ring schedule
+            # the sequential columns are exactly the interleave: column
+            # k's chunk rotations have no dependence on column k+1's
+            # contraction, so the rotation rides under the next column's
+            # local matmuls.
             ops = _colpass_operators(core, foffs0, foffs1)
             body = (
                 _colpass_einsum_body
@@ -645,9 +698,14 @@ def _column_pass_fwd_group_fn(core, subgrid_size, axis_name=None):
 
             def per_col(xs):
                 NMBF, so, m0, m1 = xs
+                if body is _colpass_pallas_body:
+                    return body(
+                        core, subgrid_size, ops, NMBF, foffs1, so, m0, m1,
+                        axis_name, True, None, collective, n_shards,
+                    )
                 return body(
                     core, subgrid_size, ops, NMBF, foffs1, so, m0, m1,
-                    axis_name,
+                    axis_name, True, collective, n_shards,
                 )
 
             return jax.lax.map(
@@ -673,12 +731,14 @@ def _column_pass_fwd_group_j(core, subgrid_size):
 
 
 @functools.lru_cache(maxsize=None)
-def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
+def _column_pass_fwd_group_sharded_cached(core, mesh, subgrid_size,
+                                          collective):
     return _shmap(
         _scoped(
             "swiftly/fwd.column_pass",
             _column_pass_fwd_group_fn(
-                core, subgrid_size, axis_name=FACET_AXIS
+                core, subgrid_size, axis_name=FACET_AXIS,
+                collective=collective, n_shards=_mesh_size(mesh),
             ),
         ),
         mesh,
@@ -687,6 +747,14 @@ def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
             _P(), _P(), _P(),
         ),
         out_specs=_P(),
+    )
+
+
+def _column_pass_fwd_group_sharded(core, mesh, subgrid_size):
+    """Group column pass under the CURRENT collective schedule (see
+    `_column_pass_fwd_sharded` — same call-time resolution)."""
+    return _column_pass_fwd_group_sharded_cached(
+        core, mesh, subgrid_size, _resolve_collective_env(_mesh_size(mesh))
     )
 
 
@@ -3069,6 +3137,9 @@ class StreamedForward:
         )
         if base.mesh is not None:
             self.last_plan["mesh_shards"] = _mesh_size(base.mesh)
+            self.last_plan["collective"] = _resolve_collective_env(
+                _mesh_size(base.mesh)
+            )
             samfn = _facet_pass_sampled_sharded(
                 core, base.mesh, self._facets_real
             )
@@ -3300,6 +3371,10 @@ class StreamedForward:
                 "bm": bm, "bn": bn, "bk": bk,
                 "sblock": _colpass_sblock(),
             }
+        if base.mesh is not None:
+            self.last_plan["collective"] = _resolve_collective_env(
+                _mesh_size(base.mesh)
+            )
         fp_flops = step_flops = coll_bytes = 0
         if _metrics.enabled():
             from ..utils.flops import (

@@ -55,6 +55,7 @@ from .model import (
     bucket_shape,
     bucket_sizes,
     hbm_budget_bytes,
+    price_collective_candidates,
     price_colpass_candidates,
     projected_column_bytes,
     projected_request_bytes,
@@ -83,6 +84,7 @@ __all__ = [
     "plan_delta",
     "plan_mesh_layout",
     "price_cache_tier",
+    "price_collective_candidates",
     "price_colpass_candidates",
     "projected_column_bytes",
     "projected_request_bytes",

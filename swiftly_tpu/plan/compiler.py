@@ -314,6 +314,15 @@ class MeshLayout:
     the layout; the mesh-streamed engine
     (`swiftly_tpu.mesh.MeshStreamedForward` / ``...Backward``) flips it
     to ``"bound"`` and records the padding it actually executed.
+
+    ``collective`` is the PLANNED facet-axis reduction schedule (psum —
+    the blocking all-reduce — or ring, the `ppermute` pipeline whose
+    chunk rotations hide behind compute; `parallel.sharded`): an
+    explicit SWIFTLY_MESH_COLLECTIVE wins, ``auto`` lets CALIBRATED
+    coefficients pick the faster-priced row of
+    ``collective_candidates`` (`model.price_collective_candidates`) and
+    stays psum under defaults — the same defaults-only-RANK rule as the
+    colpass candidates. bench asserts executed == planned.
     """
 
     n_devices: int = 1
@@ -325,6 +334,8 @@ class MeshLayout:
     fits_hbm: bool | None = None
     collective_bytes_per_column: int = 0
     collective_bytes_total: int = 0
+    collective: str = "psum"
+    collective_candidates: list = field(default_factory=list)
 
     def bind(self):
         """Mark the layout consumed by an executor."""
@@ -332,7 +343,7 @@ class MeshLayout:
         return self
 
     def as_dict(self):
-        return {
+        out = {
             "n_devices": self.n_devices,
             "facet_shards": self.facet_shards,
             "axis": self.axis,
@@ -344,10 +355,16 @@ class MeshLayout:
                 self.collective_bytes_per_column
             ),
             "collective_bytes_total": int(self.collective_bytes_total),
+            "collective": self.collective,
         }
+        if self.collective_candidates:
+            out["collective_candidates"] = list(
+                self.collective_candidates
+            )
+        return out
 
 
-def plan_mesh_layout(inputs, mode="roundtrip-streamed"):
+def plan_mesh_layout(inputs, mode="roundtrip-streamed", coeffs=None):
     """The mesh layout the cost model chooses for ``inputs``.
 
     Shard count: every planned device, capped at the facet count (a
@@ -358,8 +375,14 @@ def plan_mesh_layout(inputs, mode="roundtrip-streamed"):
     bytes are the forward column psum (ring all-reduce accounting) plus
     — for round-trip modes — the backward's replicated-subgrid
     placement traffic, totalled over the cover.
+
+    Collective schedule: SWIFTLY_MESH_COLLECTIVE=psum|ring forces the
+    stage; ``auto`` (default) prices both schedules when ``coeffs`` is
+    given and lets a CALIBRATED model pick the cheaper one, otherwise
+    keeps psum — defaults only rank, they never flip the executed
+    schedule (the same gate the colpass candidates obey).
     """
-    from ..parallel.mesh import pad_to_shards
+    from ..parallel.mesh import pad_to_shards, resolve_collective
     from ..utils.profiling import column_collective_bytes
 
     shards = max(1, min(int(inputs.n_devices), int(inputs.n_facets)))
@@ -381,7 +404,7 @@ def plan_mesh_layout(inputs, mode="roundtrip-streamed"):
             core, shards, inputs.subgrids_per_column, "backward",
             subgrid_size=inputs.xA,
         )
-    return MeshLayout(
+    layout = MeshLayout(
         n_devices=int(inputs.n_devices),
         facet_shards=shards,
         padded_facets=int(padded),
@@ -390,6 +413,27 @@ def plan_mesh_layout(inputs, mode="roundtrip-streamed"):
         collective_bytes_per_column=int(col_fwd),
         collective_bytes_total=int(total),
     )
+    env = os.environ.get("SWIFTLY_MESH_COLLECTIVE", "auto")
+    resolve_collective(shards)  # reject malformed env values loudly
+    if coeffs is not None and shards > 1 and total:
+        from .model import price_collective_candidates
+
+        layout.collective_candidates = price_collective_candidates(
+            inputs, coeffs, mesh=layout, mode=mode
+        )
+    if shards <= 1:
+        layout.collective = "psum"
+    elif env in ("psum", "ring"):
+        layout.collective = env
+    elif (
+        coeffs is not None
+        and coeffs.calibrated
+        and layout.collective_candidates
+    ):
+        layout.collective = layout.collective_candidates[0]["collective"]
+    else:
+        layout.collective = "psum"
+    return layout
 
 
 @dataclass
@@ -471,7 +515,7 @@ class Plan:
                           else " (EXCEEDS HBM)")
                 )
                 + f", {self.mesh.collective_bytes_total / 1e9:.2f} GB "
-                f"ICI collectives/cover"
+                f"ICI collectives/cover ({self.mesh.collective})"
                 if self.mesh.facet_shards > 1
                 else ""
             ),
@@ -548,8 +592,10 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
 
     With a multi-shard ``mesh`` the prediction prices PER-SHARD HBM
     (facet stack, backward accumulator and row pipeline all shard over
-    the facet axis) and adds the ICI collective stage (`mesh.psum`,
-    priced by bytes — the layout's ring all-reduce total). Under the
+    the facet axis) and adds the ICI collective stage — `mesh.psum` or
+    `mesh.ring_step` per the layout's planned ``collective``, priced by
+    bytes (`model.price_collective_stage`, overlap-discounted for
+    default-pedigree ring rates). Under the
     feed-once/fold-many schedule the HBM peak carries ``feed_group``
     shared pass residencies, and the feed traffic prices once per feed
     (`price_backward`'s ``bwd.feed_group`` stage).
@@ -564,9 +610,13 @@ def _predict(inputs, parts, fold_group, coeffs, mode, use_spill,
             feed_group=feed_group,
         )
     if mesh is not None and shards > 1 and mesh.collective_bytes_total:
+        from .model import price_collective_stage
+
         stages.append(
-            coeffs.price(
-                "mesh.psum", bytes_moved=mesh.collective_bytes_total
+            price_collective_stage(
+                coeffs,
+                getattr(mesh, "collective", "psum"),
+                mesh.collective_bytes_total,
             )
         )
     wall = sum(s.wall_s for s in stages)
@@ -744,7 +794,7 @@ def compile_plan(
     # the mesh layout falls out of the same model (arXiv 2002.03260):
     # chosen before the candidate search so every prediction prices the
     # per-shard HBM and the ICI collective bytes of the SAME layout
-    mesh = plan_mesh_layout(inputs, mode=mode)
+    mesh = plan_mesh_layout(inputs, mode=mode, coeffs=coeffs)
 
     # -- fold-group search (the measured-feedback lever) ---------------------
     candidates = sorted(

@@ -40,6 +40,8 @@ __all__ = [
     "bucket_shape",
     "bucket_sizes",
     "hbm_budget_bytes",
+    "price_collective_candidates",
+    "price_collective_stage",
     "price_colpass_candidates",
     "projected_column_bytes",
     "projected_request_bytes",
@@ -383,8 +385,25 @@ _DEFAULT_BYTES_PER_S = {
     # coarse like every default — it ranks mesh plans, it is not a
     # contract (measured coefficients refit it like any other stage)
     "mesh.psum": 45e9,
+    # the ppermute ring moves the same wire bytes over the same links
+    # (XLA's all-reduce on a 1-D mesh IS a ring) — the ring schedule's
+    # win is overlap, modelled as RING_OVERLAP_DISCOUNT below, not a
+    # faster default rate. A measured mesh.ring_step coefficient (the
+    # engine's stage timer records EXPOSED wall, overlap already
+    # subtracted) replaces both the rate and the discount.
+    "mesh.ring_step": 45e9,
 }
 _DEFAULT_DISPATCH_S = 0.1
+
+# Fraction of the ring collective's raw wire wall hidden behind the next
+# facet block's shard-local contraction and the next group's h2d staging
+# fill (the engine stores one group BEHIND compute and the triple-buffer
+# prefetch thread fills staging concurrently — mesh/engine._spill_store).
+# A coarse default-pedigree anchor like the rates above: it RANKS the
+# ring against the blocking psum; a refit mesh.ring_step rate (measured
+# exposed wall) supersedes it (`price_collective_candidates` then prices
+# with zero additional discount).
+RING_OVERLAP_DISCOUNT = 0.6
 
 
 @dataclass
@@ -516,6 +535,74 @@ def price_colpass_candidates(inputs, coeffs):
             "coeff_stage": stage,
             "flops": int(total - facet_pass),
             "flops_per_s": coeffs.flops_rate(stage),
+            "predicted_wall_s": round(cost.wall_s, 4),
+        })
+    out.sort(key=lambda c: c["predicted_wall_s"])
+    return out
+
+
+def price_collective_stage(coeffs, collective, bytes_moved):
+    """The planned facet-axis collective as one priced `StageCost`.
+
+    ``psum`` prices the blocking all-reduce at the ``mesh.psum`` rate.
+    ``ring`` prices the same wire bytes at the ``mesh.ring_step`` rate
+    and — when that rate is still the default anchor — applies the
+    `RING_OVERLAP_DISCOUNT` (the hidden-behind-compute fraction). A
+    MEASURED mesh.ring_step coefficient already is the exposed rate
+    (the engine's stage timer runs after the overlapped work), so no
+    discount stacks on top of it.
+    """
+    stage = "mesh.ring_step" if collective == "ring" else "mesh.psum"
+    cost = coeffs.price(stage, bytes_moved=bytes_moved)
+    if collective == "ring" and stage not in coeffs.bytes_per_s:
+        cost.wall_s *= 1.0 - RING_OVERLAP_DISCOUNT
+    return cost
+
+
+def price_collective_candidates(inputs, coeffs, mesh=None,
+                                mode="roundtrip-streamed"):
+    """Ranked facet-axis collective candidates (psum vs ring).
+
+    The mesh analogue of `price_colpass_candidates`: each schedule is
+    priced over the SAME layout's collective bytes with its own
+    coefficient stage as pedigree. The ring row carries the schedule
+    shape — 2(shards-1) `ppermute` steps of per-chunk bytes (the
+    per-column buffer split `shards` ways) — and the overlap discount
+    applied (0 when a measured mesh.ring_step rate prices the exposed
+    wall directly). Returns dicts sorted fastest-first; like the
+    colpass table, defaults only RANK — the executor's
+    `resolve_collective` (env) and the compiler's calibrated-gate keep
+    the choice.
+    """
+    if mesh is None:
+        from .compiler import plan_mesh_layout
+
+        mesh = plan_mesh_layout(inputs, mode=mode)
+    shards = int(mesh.facet_shards)
+    total = int(mesh.collective_bytes_total)
+    if shards <= 1 or not total:
+        return []
+    steps = 2 * (shards - 1)
+    per_column = int(mesh.collective_bytes_per_column)
+    out = []
+    for collective in ("psum", "ring"):
+        stage = "mesh.ring_step" if collective == "ring" else "mesh.psum"
+        measured = stage in coeffs.bytes_per_s
+        cost = price_collective_stage(coeffs, collective, total)
+        out.append({
+            "collective": collective,
+            "coeff_stage": stage,
+            "bytes": total,
+            "steps": 1 if collective == "psum" else steps,
+            "chunk_bytes": (
+                per_column if collective == "psum"
+                else per_column // max(1, steps * shards)
+            ),
+            "overlap_discount": (
+                0.0 if collective == "psum" or measured
+                else RING_OVERLAP_DISCOUNT
+            ),
+            "bytes_per_s": coeffs.bytes_rate(stage),
             "predicted_wall_s": round(cost.wall_s, 4),
         })
     out.sort(key=lambda c: c["predicted_wall_s"])

@@ -53,7 +53,12 @@ is simulated chip death), ``fleet.health.probe`` (each active health
 probe), ``fleet.route`` (every fleet routing decision),
 ``mesh.psum`` (the mesh engine's one collective per column group —
 ``latency`` here simulates a stalled all-reduce for the watchdog,
-``shard_loss`` a device dropping out of it), ``mesh.feed`` (each
+``shard_loss`` a device dropping out of it), ``mesh.ring_step``
+(the same collective site when SWIFTLY_MESH_COLLECTIVE=ring schedules
+the ppermute pipeline — a stalled ring step raises
+``CollectiveStalledError`` and the re-plan ladder rebuilds on
+survivors with the ring re-resolved for the new shard count),
+``mesh.feed`` (each
 mesh backward group feed), ``mesh.shard_loss`` (each mesh forward
 column-group yield — the canonical site for killing one of N virtual
 shards mid-stream).

@@ -141,6 +141,7 @@ def test_sampled_row_indices_128k_geometry():
         )
 
 
+@pytest.mark.slow
 def test_128k_proxy_streamed_forward_vs_oracle():
     """StreamedForward (sampled path) at N=131072 with the FULL
     yN = 65536 — the boundary value — against the direct-DFT oracle.
@@ -340,6 +341,7 @@ def test_hbm_budget_bytes_single_parser(monkeypatch):
     assert hbm_budget_bytes() is None  # CPU, no env -> unlimited
 
 
+@pytest.mark.slow
 def test_128k_proxy_row_slab_roundtrip_dryrun():
     """Dryrun validation of the row-slab round trip AT 128k GEOMETRY
     (N=131072, the full boundary yN=65536) on the CPU proxy: a partial

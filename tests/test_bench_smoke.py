@@ -477,6 +477,56 @@ def test_compare_forward_mfu_sentinel_synthetic(tmp_path):
     assert leg["colpass"] == "einsum"
 
 
+def test_compare_collective_pedigree_sentinel_synthetic(tmp_path):
+    """The mesh SE sentinel's COLLECTIVE pedigree in
+    scripts/bench_compare.py, exercised in tier-1 on synthetic
+    mesh-leg records (the colpass-pedigree rule applied to the
+    facet-axis reduction): identical records stay green, a doctored
+    2x-better-SE reference — wall UNCHANGED, isolating the SE leg —
+    trips with the verdict naming the executed collective, so a
+    regression that is really a silent ring->psum fallback is readable
+    from the verdict alone; the pedigree falls back to the compiled
+    prediction when the executed stamp is absent."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import compare, load_records
+    from scripts.bench_compare import main as compare_main
+
+    def rec(se=0.06, collective="ring"):
+        mesh = {"scaling_efficiency": se}
+        if collective is not None:
+            mesh["collective"] = collective
+        return {
+            "metric": "1k[1]-n512-256 mesh-streamed round-trip "
+                      "wall-clock (25 subgrids, planar f32, "
+                      "mesh-streamed, cpu)",
+            "value": 42.0,
+            "unit": "s",
+            "mesh": mesh,
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    latest.write_text(json.dumps(rec()))
+    ref.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # doctored 2x-better-SE reference, wall unchanged -> trip, and the
+    # tripped verdict names the executed collective
+    ref.write_text(json.dumps(rec(se=0.12)))
+    assert compare_main(args) == 1
+    report = compare(load_records(latest), load_records(ref))
+    (leg,) = report["legs"]
+    assert leg["collective"] == "ring"
+    assert any("collective=ring" in p for p in leg["problems"])
+    # pedigree fallback: executed stamp absent -> compiled prediction
+    fallback = rec(collective=None)
+    fallback["plan_compiled"] = {"mesh": {"collective": "psum"}}
+    latest.write_text(json.dumps(fallback))
+    report = compare(load_records(latest), load_records(ref))
+    (leg,) = report["legs"]
+    assert leg["collective"] == "psum"
+
+
 def test_bench_mesh_smoke_leg(tmp_path):
     """The `bench.py --mesh --smoke` leg (ISSUE-8 acceptance), run
     exactly as the driver would — fresh subprocess, CPU with 8 virtual
@@ -525,10 +575,15 @@ def test_bench_mesh_smoke_leg(tmp_path):
     assert mesh["match"]["max_abs_diff"] <= mesh["match"]["tolerance"]
     assert mesh["spill"]["complete"] and mesh["forward_passes"] == 1
     assert mesh["scaling_efficiency"] > 0
+    # default env: the blocking psum schedule, executed == planned
+    assert mesh["collective"] == "psum"
+    assert mesh["hlo"]["all_reduce"] >= 1
+    assert mesh["hlo"]["collective_permute"] == 0
     # the engine consumed the compiled layout — the stub flipped
     pc = record["plan_compiled"]
     assert pc["mesh"]["status"] == "bound"
     assert pc["mesh"]["facet_shards"] == 8
+    assert pc["mesh"]["collective"] == "psum"
     assert "mesh.psum" in pc["predicted"]["stages"]
     assert record["manifest"]["device"]["platform"] == "cpu"
     assert record["manifest"]["device"]["count"] == 8

@@ -295,6 +295,7 @@ def test_checkpoint_file_is_valid_zip_after_kill_during_save(tmp_path):
     assert zipfile.is_zipfile(ck)
 
 
+@pytest.mark.slow
 def test_mesh_restore_places_facet_sharded(tmp_path):
     """`restore_backward_state` with a mesh set re-places the restored
     accumulators facet-sharded across the mesh (not all on device 0)."""

@@ -332,7 +332,7 @@ def test_mesh_elastic_recovery_survives_shard_loss(cover, tmp_path):
         survivor_mesh(mesh, lost_shard=N_SHARDS)
 
 
-def test_plan_mesh_layout_and_validators(cover):
+def test_plan_mesh_layout_and_validators(cover, monkeypatch):
     """The MeshLayout stub flip (ISSUE-8 satellite): compile_plan with
     n_devices emits a non-trivial layout priced from the cost model;
     n_devices=1 stays the trivial stub; validate_plan_artifact accepts
@@ -353,6 +353,26 @@ def test_plan_mesh_layout_and_validators(cover):
         layout.collective_bytes_total
         > layout.collective_bytes_per_column
     )
+    # collective selection (ISSUE-17): auto stays psum with default
+    # coefficients (defaults only RANK), an explicit env forces the
+    # schedule, and CALIBRATED coefficients let auto pick the
+    # faster-priced candidate — ring, under the overlap-discounted
+    # default ring rate
+    from swiftly_tpu.plan.model import CostCoefficients
+
+    assert layout.collective == "psum"
+    monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", "ring")
+    assert plan_mesh_layout(inputs).collective == "ring"
+    monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", "auto")
+    measured = CostCoefficients(source="measured")
+    auto = plan_mesh_layout(inputs, coeffs=measured)
+    assert auto.collective == "ring"
+    assert auto.collective_candidates[0]["collective"] == "ring"
+    assert auto.collective_candidates[0]["steps"] == 2 * (4 - 1)
+    assert {c["collective"] for c in auto.collective_candidates} == {
+        "psum", "ring",
+    }
+    monkeypatch.delenv("SWIFTLY_MESH_COLLECTIVE")
 
     plan = compile_plan(inputs)
     assert plan.mesh.status == "stub"
@@ -403,13 +423,156 @@ def test_plan_mesh_layout_and_validators(cover):
     assert "degraded layouts" in report
     assert "(one shard lost)" in report
     assert "(half the mesh lost)" in report
+    # ...and the ranked collective-alternative table (ISSUE-17): both
+    # schedules priced, the planned one marked, defaults only RANK
+    assert "collective alternatives" in report
+    assert "mesh.ring_step" in report
+    assert "<- planned" in report
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, N_SHARDS])
+def test_ring_matches_psum_across_shard_counts(
+    cover, monkeypatch, n_shards
+):
+    """Ring-vs-psum equivalence (ISSUE-17 tentpole): the ppermute ring
+    reduction reproduces the blocking psum round trip at 2, 4 and 8
+    virtual shards — including the PADDED case (9 facets over 8 shards
+    pads to 16, and at 2/4 shards to 10/12: the zero-padded facets
+    contribute exact zeros to every ring chunk, so padding never
+    widens the reduction-order drift). Forward group streams AND
+    finished facets both match; the engine reports and stamps the
+    executed schedule."""
+    config, facet_configs, facet_tasks, subgrid_configs, _m8 = cover
+    mesh = make_facet_mesh(n_devices=n_shards)
+
+    def run(collective):
+        monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", collective)
+        mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+        assert mfwd.collective == collective
+        bwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+        groups = _feed(mfwd, bwd, subgrid_configs)
+        assert mfwd.last_plan["collective"] == collective
+        return groups, np.asarray(bwd.finish())
+
+    groups_psum, facets_psum = run("psum")
+    groups_ring, facets_ring = run("ring")
+    # reduction-order tolerance: same partial products, different sum
+    # order (ring chunk rotation vs psum tree) — not bit-identity
+    scale = float(np.max(np.abs(facets_psum)))
+    assert len(groups_ring) == len(groups_psum)
+    for g_ring, g_psum in zip(groups_ring, groups_psum):
+        np.testing.assert_allclose(
+            g_ring, g_psum, atol=1e-9 * max(scale, 1.0)
+        )
+    np.testing.assert_allclose(
+        facets_ring, facets_psum, atol=1e-9 * max(scale, 1.0)
+    )
+
+
+def test_ring_kill_resume_bit_identity(cover, tmp_path, monkeypatch):
+    """Kill+resume bit-identity THROUGH a ring-scheduled pass
+    (ISSUE-17): a ``mesh.shard_loss`` injected mid-pass under
+    SWIFTLY_MESH_COLLECTIVE=ring re-plans 8 -> 7 on the survivors with
+    the ring RE-RESOLVED for the new shard count (the replanned layout
+    stamps it), and the recovered result is BIT-identical to the
+    undisturbed ring run — the backward is shard-local per-facet math
+    and the resumed feed replays cached bytes, exactly the psum-path
+    contract."""
+    from swiftly_tpu.mesh import run_elastic_pass
+    from swiftly_tpu.plan import PlanInputs
+    from swiftly_tpu.resilience import FaultPlan, faults
+    from swiftly_tpu.utils.spill import SpillCache
+
+    monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", "ring")
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    mfwd.col_group = 3  # 5 columns -> 2 groups: autosave, then kill
+
+    spill = SpillCache(budget_bytes=1e9)
+    bwd_ref = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, bwd_ref, subgrid_configs, spill=spill)
+    want = np.asarray(bwd_ref.finish())
+
+    bwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    plan = FaultPlan(faults=[
+        {"site": "mesh.shard_loss", "kind": "shard_loss", "at": 1},
+    ])
+    inputs = PlanInputs.from_cover(
+        config, facet_configs, subgrid_configs, n_devices=N_SHARDS
+    )
+    with faults.active(plan):
+        fwd2, bwd, report = run_elastic_pass(
+            mfwd, bwd, subgrid_configs, spill,
+            str(tmp_path / "ring_elastic.npz"), plan_inputs=inputs,
+        )
+    np.testing.assert_array_equal(np.asarray(bwd.finish()), want)
+    assert report["shards_after"] == N_SHARDS - 1
+    info = report["recoveries"][0]
+    # the survivor layout re-resolved the ring for 7 shards
+    assert info["replanned"]["facet_shards"] == N_SHARDS - 1
+    assert info["replanned"]["collective"] == "ring"
+    assert fwd2.collective == "ring"
+
+
+def test_ring_step_stall_triggers_replan_to_survivors(
+    cover, tmp_path, monkeypatch
+):
+    """Chaos case (ISSUE-17): a stalled ``mesh.ring_step`` — injected
+    latency past a small SWIFTLY_COLLECTIVE_TIMEOUT_S — surfaces as
+    `CollectiveStalledError` from the watchdog (the silent-hang class
+    converted to a detected failure at the RING fault site), and
+    `run_elastic_pass` walks the same ladder: re-plan to the
+    survivors, resume, result within reduction-order tolerance of the
+    undisturbed run (the stall lands in the RECORDING pass — the site
+    syncs each stored group — so post-recovery groups recompute on 7
+    shards and only the sum order moves)."""
+    from swiftly_tpu.mesh import run_elastic_pass
+    from swiftly_tpu.plan import PlanInputs
+    from swiftly_tpu.resilience import FaultPlan, faults
+    from swiftly_tpu.utils.spill import SpillCache
+
+    monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", "ring")
+    config, facet_configs, facet_tasks, subgrid_configs, mesh = cover
+    mfwd = MeshStreamedForward(config, facet_tasks, mesh=mesh)
+    mfwd.col_group = 3
+
+    bwd_ref = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    _feed(mfwd, bwd_ref, subgrid_configs,
+          spill=SpillCache(budget_bytes=1e9))
+    want = np.asarray(bwd_ref.finish())
+
+    monkeypatch.setenv("SWIFTLY_COLLECTIVE_TIMEOUT_S", "0.15")
+    bwd = MeshStreamedBackward(config, facet_configs, mesh=mesh)
+    plan = FaultPlan(faults=[
+        {"site": "mesh.ring_step", "kind": "latency", "at": 1,
+         "delay_s": 0.6},
+    ])
+    inputs = PlanInputs.from_cover(
+        config, facet_configs, subgrid_configs, n_devices=N_SHARDS
+    )
+    with faults.active(plan):
+        _fwd2, bwd, report = run_elastic_pass(
+            mfwd, bwd, subgrid_configs, SpillCache(budget_bytes=1e9),
+            str(tmp_path / "ring_stall.npz"), plan_inputs=inputs,
+        )
+    assert plan.stats()["by_site"] == {"mesh.ring_step": 1}
+    info = report["recoveries"][0]
+    assert info["detected_via"] == "CollectiveStalledError"
+    assert report["shards_after"] == N_SHARDS - 1
+    got = np.asarray(bwd.finish())
+    scale = float(np.max(np.abs(want)))
+    np.testing.assert_allclose(got, want, atol=1e-9 * max(scale, 1.0))
 
 
 @pytest.mark.slow
-def test_mesh_engine_1k_drill():
+@pytest.mark.parametrize("collective", ["psum", "ring"])
+def test_mesh_engine_1k_drill(collective, monkeypatch):
     """The larger drill at the 1k catalogue config (the bench --mesh
     smoke geometry): mesh-streamed round trip over 8 shards within
-    reduction-order tolerance of single-chip, planar f32."""
+    reduction-order tolerance of single-chip, planar f32 — under both
+    collective schedules (the ring drill is the ISSUE-17 drill-scale
+    gate)."""
+    monkeypatch.setenv("SWIFTLY_MESH_COLLECTIVE", collective)
     import jax.numpy as jnp
 
     from swiftly_tpu import (

@@ -111,6 +111,7 @@ def test_planar_core_matches_numpy_core_backward():
     )
 
 
+@pytest.mark.slow
 def test_planar_f32_relative_accuracy_at_8k():
     """f32 error-growth regression at N=8192.
 

@@ -81,7 +81,10 @@ def test_pad_to_shards():
     assert pad_to_shards(1, 8) == 8
 
 
-@pytest.mark.parametrize("spmd_mode", ["shard_map", "gspmd"])
+@pytest.mark.parametrize(
+    "spmd_mode",
+    ["shard_map", pytest.param("gspmd", marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize("backend", ["jax", "planar"])
 def test_sharded_roundtrip_accuracy(backend, spmd_mode):
     mesh = make_facet_mesh()
@@ -174,7 +177,10 @@ def _fused_roundtrip(config):
     return subgrid_configs, facet_configs, subgrids, facets
 
 
-@pytest.mark.parametrize("spmd_mode", ["shard_map", "gspmd"])
+@pytest.mark.parametrize(
+    "spmd_mode",
+    ["shard_map", pytest.param("gspmd", marks=pytest.mark.slow)],
+)
 def test_fused_mesh_matches_single_device(spmd_mode):
     """Fused whole-cover programs on the mesh == single-device results."""
     mesh = make_facet_mesh()
@@ -204,6 +210,7 @@ def test_fused_mesh_matches_single_device(spmd_mode):
     assert f_err < 3e-10
 
 
+@pytest.mark.slow
 def test_fused_mesh_planar_roundtrip():
     """Planar f64 backend through the fused mesh path."""
     mesh = make_facet_mesh()

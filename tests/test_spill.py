@@ -246,7 +246,10 @@ def _run_partitioned_backward(config, facet_configs, subgrid_configs,
     return np.concatenate(outs)
 
 
-@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize(
+    "backend",
+    [pytest.param("jax", marks=pytest.mark.slow), "planar"],
+)
 def test_cache_fed_backward_bitidentical_to_replay(backend):
     """The tentpole equivalence pin: a facet-partitioned backward fed
     from the spill cache (1 forward + P cache feeds) is BIT-IDENTICAL
@@ -277,6 +280,7 @@ def test_cache_fed_backward_bitidentical_to_replay(backend):
     assert counters.get("spill.fallback_replays", 0) == 0
 
 
+@pytest.mark.slow
 def test_cache_disk_backed_feed_matches_without_prefetch(tmp_path,
                                                          monkeypatch):
     """A cache whose budget forces every entry to disk, read back with
@@ -392,6 +396,7 @@ def test_feed_once_fold_many_bitidentical_and_h2d_collapse():
     assert h2d_f < h2d_pp
 
 
+@pytest.mark.slow
 def test_feed_schedule_replay_fallback_shares_forwards():
     """Without a usable cache the schedule still helps: q passes share
     each forward REPLAY, so P per-facet passes in feeds of 2 cost

@@ -158,7 +158,10 @@ def test_streamed_subgrid_equals_direct_dft():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("residency", ["host", "device"])
+@pytest.mark.parametrize(
+    "residency",
+    [pytest.param("host", marks=pytest.mark.slow), "device"],
+)
 def test_streamed_mesh_matches_single_device(residency):
     """Streamed executors on a facet-sharded mesh == single-device."""
     from swiftly_tpu.parallel.mesh import make_facet_mesh
@@ -192,6 +195,7 @@ def test_streamed_mesh_matches_single_device(residency):
     np.testing.assert_allclose(facets_mesh, facets_single, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_streamed_mesh_planar_roundtrip():
     """Planar f64 streamed round trip on the mesh, vs the oracle."""
     from swiftly_tpu.parallel.mesh import make_facet_mesh
@@ -767,7 +771,10 @@ def test_mixed_sparse_dense_facets_densify():
     np.testing.assert_allclose(out, ref, atol=1e-10)
 
 
-@pytest.mark.parametrize("facet_group", [None, 2])
+@pytest.mark.parametrize(
+    "facet_group",
+    [pytest.param(None, marks=pytest.mark.slow), 2],
+)
 def test_group_feeding_matches_per_column(facet_group):
     """stream_column_groups + add_subgrid_group == per-column feeding,
     for both resident and facet-slab forward paths."""
