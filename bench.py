@@ -1669,6 +1669,579 @@ def _lat_quantile_ms(latencies_s, q):
     return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 3)
 
 
+def _vis_build(params, kernel, dtype):
+    """Forward + cover for the visibility leg.
+
+    Differs from `_build` in one load-bearing way: the sky model is
+    band-limited into the degrid kernel's accuracy band and GRID-
+    CORRECTED (`vis.kernel.VisKernel.correct_sources`) before facets
+    are built, so degridded samples approximate the TRUE visibilities
+    of the returned RAW sources — the direct-DFT oracle the leg audits
+    against (`vis.oracle.vis_oracle`).
+    """
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        SwiftlyForward,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+
+    config = SwiftlyConfig(backend="planar", dtype=dtype, **params)
+    N = config.image_size
+    maxc = max(
+        max(abs(a), abs(b)) for a, b in _BENCH_SOURCE_FRACTIONS
+    )
+    # 0.9 of the band edge: the kernel fit's error grows toward the
+    # band boundary, so the margin keeps the measured oracle RMS well
+    # inside DEGRID_TOLERANCE instead of brushing it
+    scale = 0.9 * kernel.band / 2.0 / maxc
+    raw = [
+        (w, int(x * scale), int(y * scale))
+        for (w, x, y) in _bench_sources(N)
+    ]
+    corrected = kernel.correct_sources(raw, N)
+    facet_configs = make_full_facet_cover(config)
+    tasks = [
+        (fc, make_facet(N, fc, corrected)) for fc in facet_configs
+    ]
+    fwd = SwiftlyForward(config, tasks, lru_forward=2, queue_size=64)
+    return config, fwd, facet_configs, make_full_subgrid_cover(config), raw
+
+
+def _vis_zipf_uv(subgrid_configs, n_samples, seed, zipf_s, margin, N):
+    """Zipf-over-(u, v) sample workload: columns ranked zipf (shuffled
+    popularity, p ∝ 1/rank^s), samples uniform inside a column subgrid's
+    interior (``margin`` pixels in from the span edge, so the kernel
+    footprint lands in-cover), plus a 10% uniform-over-the-grid tail
+    whose off-cover samples exercise the structured shed path.
+
+    :return: ([n, 2] uv array, hottest column's off0)
+    """
+    rng = np.random.default_rng(seed)
+    cols = sorted({sg.off0 for sg in subgrid_configs})
+    by_col = {}
+    for sg in subgrid_configs:
+        by_col.setdefault(sg.off0, []).append(sg)
+    order = rng.permutation(len(cols))
+    ranks = np.empty(len(cols), dtype=int)
+    ranks[order] = np.arange(len(cols))
+    p = 1.0 / (ranks + 1.0) ** zipf_s
+    p /= p.sum()
+    n_spread = n_samples // 10
+    n_zipf = n_samples - n_spread
+    uv = np.empty((n_samples, 2))
+    picks = rng.choice(len(cols), size=n_zipf, p=p)
+    for i, c in enumerate(picks):
+        col = by_col[cols[c]]
+        sg = col[rng.integers(len(col))]
+        half = sg.size / 2.0 - margin
+        uv[i] = (
+            sg.off0 + rng.uniform(-half, half),
+            sg.off1 + rng.uniform(-half, half),
+        )
+    uv[n_zipf:] = rng.uniform(0, N, size=(n_spread, 2))
+    return uv, cols[int(np.argmax(p))]
+
+
+def vis_bench(smoke_mode=False):
+    """`bench.py --vis [--smoke]`: the visibility-serving leg.
+
+    Replays a zipf-over-(u, v) workload through
+    `swiftly_tpu.vis.VisibilityService` (sample batches split by owning
+    subgrid, coalesced by column through the serve admission/scheduling
+    machinery, answered by one degrid dispatch per touched subgrid off
+    cache-fed or computed rows) and stamps the ``vis`` artifact block:
+    latency quantiles, shed/coalesce/cache rates, served-sample
+    throughput — AUDITED for accuracy, not just speed: every served
+    sample is compared against the direct-DFT oracle
+    (`vis.oracle.vis_oracle`, rel RMS within the kernel's stamped
+    tolerance), the degrid/grid adjoint dot-product identity is
+    asserted, and the gridded batch round-trips into
+    `parallel.streamed.StreamedBackward.add_subgrid_group`.
+
+    Drills folded into the replay: an admission-queue overload burst
+    (structured "depth" sheds), a FORCED spill eviction (later hot-
+    column lookups fall back to recomputation), a boundary-straddling
+    batch shed with ``outside_cover``, and a facet update after which
+    the version-pinned `vis.VisGridder` REFUSES stale-era batches and
+    the service serves compute-path only (the dropped feed's rows
+    belong to the superseded stack). Served cache-path samples are
+    verified BIT-IDENTICAL against direct `vis.degrid.degrid_batch` on
+    rows from a fresh forward. A small `serve.SubgridService` burst on
+    the same forward anchors the throughput contract: served samples/s
+    must be >= 10x the subgrid-serving request rate (the whole point
+    of serving samples instead of rows).
+
+    With ``--smoke`` the leg validates the artifact schema
+    (`obs.validate_vis_artifact`) plus the drill outcomes and exits
+    nonzero on any problem — wired into tier-1 via
+    tests/test_bench_smoke.py.
+    """
+    import jax
+
+    from swiftly_tpu import api as _api
+    from swiftly_tpu.models import SWIFT_CONFIGS
+    from swiftly_tpu.obs import metrics, run_manifest, validate_vis_artifact
+    from swiftly_tpu.parallel import StreamedBackward
+    from swiftly_tpu.parallel.streamed import CachedColumnFeed
+    from swiftly_tpu.plan import price_vis
+    from swiftly_tpu.serve import (
+        AdmissionQueue,
+        CoalescingScheduler,
+        SubgridService,
+    )
+    from swiftly_tpu.utils import enable_compilation_cache
+    from swiftly_tpu.utils.spill import SpillCache
+    from swiftly_tpu.vis import (
+        ADJOINT_TOLERANCE,
+        VisGridder,
+        VisibilityService,
+        degrid_batch,
+        grid_batch,
+        vis_kernel,
+        vis_oracle,
+    )
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    out_path = os.environ.get("BENCH_VIS_OUT", "BENCH_vis.json")
+    if smoke_mode:
+        os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+        metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get("BENCH_VIS_CONFIG", "")
+    n_samples = int(os.environ.get("BENCH_VIS_SAMPLES", "2000"))
+    seed = int(os.environ.get("BENCH_VIS_SEED", "1234"))
+    zipf_s = float(os.environ.get("BENCH_VIS_ZIPF_S", "1.1"))
+    max_depth = int(os.environ.get("BENCH_VIS_DEPTH", "64"))
+    max_batch = int(os.environ.get("BENCH_VIS_MAX_BATCH", "16"))
+    slo_ms = float(os.environ.get("BENCH_VIS_SLO_MS", "30000"))
+    n_serve = int(os.environ.get("BENCH_VIS_SERVE_REQUESTS", "24"))
+
+    if name:
+        params = dict(SWIFT_CONFIGS[name])
+        params.setdefault("fov", 1.0)
+    else:
+        # smoke-scale geometry (the tests' known-good small set: real
+        # PSWF margin between yB and yN, so served rows carry signal)
+        name = "vis-n256"
+        params = dict(W=8.0, fov=1.0, N=256, yB_size=96, yN_size=128,
+                      xA_size=56, xM_size=64)
+    kernel = vis_kernel()
+    platform = jax.devices()[0].platform
+    config, fwd, facet_configs, subgrid_configs, sources = _vis_build(
+        params, kernel, jax.numpy.float32
+    )
+    N = config.image_size
+    uv_all, hot_off0 = _vis_zipf_uv(
+        subgrid_configs, n_samples, seed, zipf_s,
+        kernel.support + 1, N,
+    )
+    cols_sorted = sorted({sg.off0 for sg in subgrid_configs})
+    hot_col = [sg for sg in subgrid_configs if sg.off0 == hot_off0]
+
+    # cache feed seeded from the hottest column through the SAME
+    # per-subgrid program the compute fallback uses — feed hits stay
+    # bit-identical to fallback recompute. Mid-run the spill is
+    # force-evicted: later hot-column lookups raise and the service
+    # falls back to recomputation (the spill-replay degrade contract).
+    hot_rows = [np.asarray(fwd.get_subgrid_task(sg)) for sg in hot_col]
+    spill = SpillCache(budget_bytes=2**30)
+    spill.begin_fill(tag=("vis-seed", name, len(hot_col)))
+    spill.put([list(enumerate(hot_col))], np.stack(hot_rows)[None])
+    spill.end_fill()
+    feed = CachedColumnFeed(spill)
+
+    service = VisibilityService(
+        fwd,
+        subgrid_configs=subgrid_configs,
+        kernel=kernel,
+        cache_feed=feed,
+        queue=AdmissionQueue(max_depth=max_depth),
+        scheduler=CoalescingScheduler(
+            max_batch=max_batch, urgency_s=0.05
+        ),
+        slo_ms=slo_ms,
+    )
+
+    from swiftly_tpu.obs import trace as otrace
+
+    rng = np.random.default_rng(seed + 1)
+    burst = max(16, n_samples // 12)
+    bursts = [
+        uv_all[i : i + burst] for i in range(0, len(uv_all), burst)
+    ]
+    # in-cover point on the hottest subgrid: the overload drill's
+    # repeated target (same owning subgrid -> coalesced singles)
+    hot_pt = np.array(
+        [[hot_col[0].off0 + 0.3, hot_col[0].off1 + 0.3]]
+    )
+    # a kernel footprint straddling the border between the first two
+    # columns can be answered by neither side's row: structured shed
+    border = (cols_sorted[0] + cols_sorted[1]) / 2.0
+    uv_outside = np.array(
+        [[border + 0.25, hot_off0], [border - 0.25, hot_off0]]
+    )
+
+    tracked = []
+    vis_span = otrace.span("bench.vis", cat="bench", config=name)
+    t0 = time.time()
+    vis_span.__enter__()
+    # overload drill: 1.5x the admission depth as single-sample
+    # submissions with no pump between them — past max_depth they shed
+    # with the queue's structured "depth" reason; the admitted ones
+    # coalesce (one subgrid) into max_batch-sized degrid dispatches
+    for _ in range(int(max_depth * 1.5)):
+        tracked.append((hot_pt, service.submit(hot_pt)))
+    while service.pump_once():
+        pass
+    outside_handle = None
+    pending = 0
+    for k, b in enumerate(bursts):
+        if k == 1:
+            outside_handle = service.serve(uv_outside)
+        if k == 3:
+            spill.reset()  # forced eviction: feed index now dangles
+        tracked.append(
+            (b, service.submit(b, priority=int(rng.integers(0, 4))))
+        )
+        pending += 1
+        # drain every second burst so concurrent batches overlap on the
+        # hot columns (the coalescing the scheduler exists for)
+        if pending >= 2 or k == len(bursts) - 1:
+            while service.pump_once():
+                pass
+            pending = 0
+    vis_span.__exit__(None, None, None)
+    wall = time.time() - t0
+    stats_run = service.stats()
+
+    # accuracy audit: every served sample vs the direct-DFT oracle of
+    # the RAW (band-limited, uncorrected) sky model
+    served_uv, served_vis = [], []
+    for uv_b, h in tracked:
+        m = np.isfinite(h.data)
+        if m.any():
+            served_uv.append(np.atleast_2d(uv_b)[m])
+            served_vis.append(h.data[m])
+    served_uv = np.concatenate(served_uv)
+    served_vis = np.concatenate(served_vis)
+    oracle = vis_oracle(sources, served_uv, N)
+    degrid_rms = float(
+        np.sqrt(np.mean(np.abs(served_vis - oracle) ** 2))
+        / max(np.sqrt(np.mean(np.abs(oracle) ** 2)), 1e-30)
+    )
+
+    # bit-identity audit: served samples vs direct degrid_batch on rows
+    # from a FRESH forward (fresh LRU/queue; per-lane einsum
+    # independence makes batch shape irrelevant to the bits)
+    _c2, fwd_ref, _fc2, _sg2, _src2 = _vis_build(
+        params, kernel, jax.numpy.float32
+    )
+    ref_rows = {}
+    checked = mismatches = 0
+    for uv_b, h in tracked:
+        owners, _shed = service.cover.map_samples(np.atleast_2d(uv_b))
+        for key, entry in owners.items():
+            got = h.data[entry["idx"]]
+            m = np.isfinite(got)
+            if not m.any():
+                continue
+            if key not in ref_rows:
+                ref_rows[key] = np.asarray(
+                    fwd_ref.get_subgrid_task(service.cover.config(*key))
+                )
+            ref = degrid_batch(
+                ref_rows[key], entry["iu0"], entry["iv0"],
+                kernel.weights(entry["fu"], dtype=np.float64),
+                kernel.weights(entry["fv"], dtype=np.float64),
+            )
+            checked += int(m.sum())
+            mismatches += int(np.sum(got[m] != ref[m]))
+
+    # adjoint audit: < degrid(G), y > == < G, grid(y) > over a fresh
+    # in-cover batch (the dot-product identity pinning grid as the
+    # exact adjoint; float32 accumulation noise only)
+    rng_adj = np.random.default_rng(seed + 5)
+    half = hot_col[0].size / 2.0 - kernel.support - 1
+    uv_adj = np.stack(
+        [
+            hot_off0 + rng_adj.uniform(-half, half, size=64),
+            hot_col[0].off1 + rng_adj.uniform(-half, half, size=64),
+        ],
+        axis=1,
+    )
+    owners_adj, _ = service.cover.map_samples(uv_adj)
+    lhs = rhs = 0.0 + 0.0j
+    for key, entry in owners_adj.items():
+        sg = service.cover.config(*key)
+        row = ref_rows.get(key)
+        if row is None:
+            row = np.asarray(fwd_ref.get_subgrid_task(sg))
+        plane = row[..., 0] + 1j * row[..., 1]
+        cu = kernel.weights(entry["fu"], dtype=np.float64)
+        cv = kernel.weights(entry["fv"], dtype=np.float64)
+        d = degrid_batch(row, entry["iu0"], entry["iv0"], cu, cv)
+        y = (
+            rng_adj.normal(size=d.size)
+            + 1j * rng_adj.normal(size=d.size)
+        )
+        ar, ai = grid_batch(
+            sg.size, entry["iu0"], entry["iv0"], cu, cv, y
+        )
+        lhs += np.vdot(d, y)
+        rhs += np.vdot(plane, ar + 1j * ai)
+    adjoint_rel = float(abs(lhs - rhs) / max(abs(lhs), 1e-30))
+
+    # gridding round-trip: accumulate every served sample through the
+    # version-pinned gridder and ingest the emitted columns into the
+    # backward's add_subgrid_group form (residency="sampled")
+    gridder = VisGridder(
+        service.cover, kernel,
+        stream_version=service.stream_version,
+        version_of=lambda: service.stream_version,
+    )
+    gridder.add_batch(served_uv, served_vis)
+    col_sg_lists, stack = gridder.emit(planar=True)
+    bwd = StreamedBackward(config, facet_configs, residency="sampled")
+    bwd.add_subgrid_group(col_sg_lists, jax.numpy.asarray(stack))
+    ingested = True
+
+    # facet-update drill: version gates must hold — the pinned gridder
+    # refuses the next batch outright, the dropped feed's rows are
+    # unreachable (hits frozen), and post-update serving is compute-path
+    pre_update_hits = service.stats()["cache_hits"]
+    service.post_facet_update()
+    stale_refused = False
+    try:
+        gridder.add_batch(served_uv[:4], served_vis[:4])
+    except LookupError:
+        stale_refused = True
+    post_handle = service.serve(hot_pt)
+    post_compute_only = all(
+        r.result is not None and r.result.ok
+        and r.result.path == "compute"
+        for r in post_handle.children
+    )
+    post_hits_delta = service.stats()["cache_hits"] - pre_update_hits
+
+    # throughput anchor: a subgrid-serving burst on the SAME forward —
+    # the rate a row-granular client would get; served samples/s must
+    # beat it 10x or visibility serving has no reason to exist
+    serve_reqs, _hot2 = _zipf_workload(
+        subgrid_configs, n_serve, seed + 7, zipf_s
+    )
+    serve_svc = SubgridService(
+        fwd,
+        queue=AdmissionQueue(max_depth=max_depth),
+        scheduler=CoalescingScheduler(max_batch=1, urgency_s=0.05),
+    )
+    t1 = time.time()
+    serve_tracked = [serve_svc.submit(sg) for sg in serve_reqs]
+    while serve_svc.pump_once():
+        pass
+    serve_wall = time.time() - t1
+    serve_stats = serve_svc.stats()
+    serve_rps = (
+        serve_stats["n_served"] / serve_wall if serve_wall else 0.0
+    )
+    samples_per_s = (
+        stats_run["n_served_samples"] / wall if wall else 0.0
+    )
+    serve_ratio = samples_per_s / serve_rps if serve_rps else 0.0
+
+    stats = service.stats()
+    n_cols = len(cols_sorted)
+    hit_rate = stats["cache_hits"] / max(1, stats["n_batches"])
+    plan = price_vis(
+        n_samples=stats["n_samples"],
+        subgrid_size=config.max_subgrid_size,
+        support=kernel.support,
+        cache_hit_rate=hit_rate,
+        include_grid=True,
+    )
+    vis_block = {
+        **stats,
+        "throughput_ksamples_s": round(samples_per_s / 1e3, 4),
+        "degrid_rms": degrid_rms,
+        "kernel": kernel.as_dict(),
+        "adjoint": {
+            "rel_err": adjoint_rel,
+            "tolerance": ADJOINT_TOLERANCE,
+        },
+        "grid": {
+            "n_gridded": gridder.n_gridded,
+            "n_shed": gridder.n_shed,
+            "batches": gridder.batches,
+            "columns": len(col_sg_lists),
+            "ingested": ingested,
+            "stale_refused": stale_refused,
+        },
+        "serve_baseline": {
+            "n_requests": n_serve,
+            "n_served": serve_stats["n_served"],
+            "wall_s": round(serve_wall, 4),
+            "rps": round(serve_rps, 3),
+            "samples_per_s": round(samples_per_s, 2),
+            "ratio": round(serve_ratio, 2),
+        },
+        "version_gate": {
+            "facet_updates": stats["facet_updates"],
+            "gridder_refused": stale_refused,
+            "post_update_cache_hits_delta": post_hits_delta,
+            "post_update_compute_only": post_compute_only,
+        },
+        "plan": plan.as_dict(),
+    }
+    record = {
+        "metric": (
+            f"{name} visibility serving ({stats['n_samples']} zipf "
+            f"(u,v) samples over {n_cols} columns, planar f32, "
+            f"{platform})"
+        ),
+        "value": round(wall, 4),
+        "unit": "s",
+        "throughput_rps": round(stats["n_served"] / wall, 2) if wall else 0.0,
+        "vis": vis_block,
+        "bit_identical": {"checked": checked, "mismatches": mismatches},
+        "cache_feed": {
+            "indexed": len(feed),
+            "hits": feed.hits,
+            "misses": feed.misses,
+            "evicted": feed.evicted,
+        },
+        "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
+        "includes_compile": True,
+        "n_subgrids_cover": len(subgrid_configs),
+        "dispatch_path": _api.last_dispatch_path(),
+        "plan_compiled": {
+            "predicted": {"stages": plan.as_dict()["predicted"]},
+            "coeffs_source": plan.coeffs_source,
+            "config": name,
+            "mode": "vis",
+        },
+        "manifest": run_manifest(
+            params={"config": name, "mode": "vis", **params},
+        ),
+    }
+    if metrics.enabled():
+        record["telemetry"] = metrics.export()
+        _stamp_plan_accuracy(record)
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+
+        summary = summarize_trace(
+            otrace.export(), root_id=getattr(vis_span, "id", None)
+        )
+        summary["leg_wall_s"] = round(wall, 6)
+        record["trace"] = summary
+        otrace.save(trace_path)
+        otrace.disable()
+
+    problems = validate_vis_artifact(record)
+    if smoke_mode:
+        # drill outcomes: schema alone is not proof the paths ran
+        total = stats["n_samples"]
+        if stats["n_served_samples"] < 0.5 * total:
+            problems.append(
+                f"served {stats['n_served_samples']}/{total} samples "
+                "(< 50%)"
+            )
+        if not checked or mismatches:
+            problems.append(
+                f"bit-identity audit failed: {mismatches} mismatches, "
+                f"{checked} checked"
+            )
+        if not stats["shed_reasons"].get("outside_cover"):
+            problems.append("no outside_cover sheds (spread tail + "
+                            "boundary drill both missed)")
+        if outside_handle is None or outside_handle.status != "shed" \
+                or outside_handle.shed_reason != "outside_cover":
+            problems.append(
+                "boundary-straddling batch was not shed outside_cover "
+                f"(got {outside_handle!r})"
+            )
+        if not stats["shed_reasons"].get("depth"):
+            problems.append(
+                "overload burst shed nothing with the 'depth' reason"
+            )
+        if not stats["cache_hits"]:
+            problems.append("cache feed served no hits")
+        if not stats["cache_fallbacks"]:
+            problems.append(
+                "forced eviction produced no cache->compute fallback"
+            )
+        if not stats["coalesce_hit_rate"] > 0:
+            problems.append("no coalesced sample slices (hit_rate == 0)")
+        if serve_ratio < 10.0:
+            problems.append(
+                f"served-sample throughput only {serve_ratio:.1f}x the "
+                "subgrid-serving request rate (contract: >= 10x)"
+            )
+        if not stale_refused:
+            problems.append(
+                "stale-pinned gridder accepted a post-update batch"
+            )
+        if post_hits_delta or not post_compute_only:
+            problems.append(
+                f"post-facet-update serving touched the dropped feed "
+                f"(hits delta {post_hits_delta}, compute_only="
+                f"{post_compute_only})"
+            )
+        if len(service.queue) != 0:
+            problems.append(f"queue wedged: {len(service.queue)} pending")
+        telemetry = record.get("telemetry") or {}
+        t_stages = telemetry.get("stages") or {}
+        if not {"vis.degrid", "vis.row_fetch", "vis.grid"} <= set(t_stages):
+            problems.append(
+                f"missing vis stages in telemetry: {sorted(t_stages)}"
+            )
+        if "vis.queue_depth_peak" not in (
+            telemetry.get("gauges_max") or {}
+        ):
+            problems.append(
+                "gauges_max missing vis.queue_depth_peak watermark"
+            )
+        if not stats.get("journey"):
+            problems.append("stats missing journey decomposition block")
+        if trace_path:
+            from swiftly_tpu.obs import validate_trace_artifact
+
+            problems.extend(validate_trace_artifact(record))
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2)
+    if smoke_mode:
+        metrics.disable()
+        print(
+            json.dumps(
+                {
+                    "vis_smoke": "ok" if not problems else "failed",
+                    "config": name,
+                    "artifact": out_path,
+                    "n_served_samples": stats["n_served_samples"],
+                    "p99_ms": stats["p99_ms"],
+                    "shed_rate": stats["shed_rate"],
+                    "degrid_rms": round(degrid_rms, 6),
+                    "adjoint_rel_err": round(adjoint_rel, 9),
+                    "serve_ratio": round(serve_ratio, 2),
+                    "throughput_ksamples_s": round(
+                        samples_per_s / 1e3, 4
+                    ),
+                    "problems": problems,
+                }
+            ),
+            flush=True,
+        )
+        return 0 if not problems else 1
+    print(json.dumps(record), flush=True)
+    return 0 if not problems else 1
+
+
 def fleet_bench(smoke_mode=False):
     """`bench.py --fleet [--smoke]`: the self-healing serve-fleet drill.
 
@@ -4460,6 +5033,8 @@ def main():
     from swiftly_tpu.obs import PartialArtifactWriter
     from swiftly_tpu.utils import enable_compilation_cache
 
+    if "--vis" in sys.argv:
+        sys.exit(vis_bench(smoke_mode="--smoke" in sys.argv))
     if "--serve" in sys.argv:
         sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
     if "--fleet" in sys.argv:

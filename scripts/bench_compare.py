@@ -72,6 +72,14 @@ artifact) and exits non-zero when a leg regressed:
   numerical-accuracy regression trips the sentinel exactly like a
   wall regression (the absolute budget lives in bench itself, see
   docs/accuracy.md; this guards the *relative* trajectory).
+* **vis p99 / throughput** — for visibility-serving legs (``--vis``
+  artifacts): ``vis.p99_ms`` (per-sample-batch tail latency, lower is
+  better) more than the threshold above the best reference, or
+  ``vis.throughput_ksamples_s`` (served samples per second, higher is
+  better) more than the threshold below it — the degrid product
+  surface regresses like any serving tier (the accuracy side is
+  absolute, enforced by `obs.validate_vis_artifact` inside the leg;
+  this guards the latency/capacity trajectory).
 
 Legs are matched by (config, mode) — taken from the stamped
 ``manifest.config_params`` when present (every record since PR 1),
@@ -219,6 +227,20 @@ SENTINELS = [
         "applies_to": "legs stamping a plan_accuracy block "
                       "(obs.ledger)",
     },
+    {
+        "name": "vis.p99_ms",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 18,
+        "applies_to": "visibility-serving (--vis) legs",
+    },
+    {
+        "name": "vis.throughput_ksamples_s",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 18,
+        "applies_to": "visibility-serving (--vis) legs",
+    },
 ]
 
 # metric strings look like
@@ -285,7 +307,8 @@ def compare(latest_records, reference_records, threshold=0.2):
             (key, leg_platform(rec)),
             {"wall": None, "mfu": None, "p99": None, "rps": None,
              "se": None, "dse": None, "rms": None, "ro": None,
-             "chr": None, "sc": None, "n": 0},
+             "chr": None, "sc": None, "vp99": None, "vks": None,
+             "n": 0},
         )
         bucket["n"] += 1
         value = rec.get("value")
@@ -331,6 +354,14 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(sc, (int, float)) and sc > 0:
             if bucket["sc"] is None or sc < bucket["sc"]:
                 bucket["sc"] = sc
+        vp99 = (rec.get("vis") or {}).get("p99_ms")
+        if isinstance(vp99, (int, float)) and vp99 > 0:
+            if bucket["vp99"] is None or vp99 < bucket["vp99"]:
+                bucket["vp99"] = vp99
+        vks = (rec.get("vis") or {}).get("throughput_ksamples_s")
+        if isinstance(vks, (int, float)) and vks > 0:
+            if bucket["vks"] is None or vks > bucket["vks"]:
+                bucket["vks"] = vks
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -508,6 +539,36 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"{sc:g} resident stream copies vs "
                     f"{ref['sc']:g} in the best reference — the "
                     "fabric's single-resident-copy claim regressed"
+                )
+        # visibility-serving legs: sample tail latency (lower is
+        # better) + served-sample capacity (higher is better) — the
+        # product-surface SLO pair `bench.py --vis` stamps
+        vp99 = (rec.get("vis") or {}).get("p99_ms")
+        if isinstance(vp99, (int, float)) and vp99 > 0:
+            verdict["vis_p99_ms"] = vp99
+            verdict["ref_vis_p99_ms"] = ref["vp99"]
+            if (
+                ref["vp99"] is not None
+                and vp99 > ref["vp99"] * (1.0 + threshold)
+            ):
+                verdict["problems"].append(
+                    f"vis p99 {vp99:.4g}ms is "
+                    f"{100 * (vp99 / ref['vp99'] - 1):.1f}% above "
+                    f"best reference {ref['vp99']:.4g}ms "
+                    f"(threshold {100 * threshold:.0f}%)"
+                )
+        vks = (rec.get("vis") or {}).get("throughput_ksamples_s")
+        if isinstance(vks, (int, float)) and vks > 0:
+            verdict["vis_throughput_ksamples_s"] = vks
+            verdict["ref_vis_throughput_ksamples_s"] = ref["vks"]
+            if (
+                ref["vks"] is not None
+                and vks < ref["vks"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"vis throughput {vks:.4g} ksamples/s is "
+                    f"{100 * (1 - vks / ref['vks']):.1f}% below best "
+                    f"reference {ref['vks']:.4g} ksamples/s"
                 )
         # precision legs: accuracy sentinel (lower is better)
         rms = rec.get("rms_vs_dft_oracle")

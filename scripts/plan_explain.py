@@ -28,6 +28,14 @@ predicted to hide the feed entirely (the h2d/compute overlap).
 ``--feed-group`` forces passes-per-feed, mirroring bench's
 ``BENCH_BWD_FEED_GROUP``.
 
+``--vis N`` switches to the visibility-serving batch table
+(`plan.price_vis`): an N-sample degrid workload over the config's
+subgrid size, the power-of-two coalescing caps scanned with the
+per-dispatch row fetch blended between cache and spill tiers at
+``--vis-hit-rate`` (``--vis-grid`` adds the adjoint accumulation) and
+the chosen ``max_batch`` marked — the priced answer to "how hard
+should the visibility scheduler coalesce".
+
 ``--cache`` switches to the serve cache-fabric tier table
 (`plan.price_cache_tier`): for ``--replicas`` N over one resident
 recorded stream, the priced per-request wall of a per-replica L1 hit
@@ -133,6 +141,24 @@ def main(argv=None):
         help="print the incremental-update break-even table instead: "
              "price a K-of-J changed-facet patch (delta stream + cache "
              "patch) against the full re-record (plan.plan_delta)",
+    )
+    ap.add_argument(
+        "--vis", type=int, default=None, metavar="SAMPLES",
+        help="print the visibility-serving batch table instead: price "
+             "a SAMPLES-sample degrid workload over the config's "
+             "subgrid size, scanning the power-of-two coalescing caps "
+             "(plan.price_vis); --vis-hit-rate blends the per-dispatch "
+             "row fetch between cache and spill tiers",
+    )
+    ap.add_argument(
+        "--vis-hit-rate", type=float, default=0.0, metavar="R",
+        help="expected cache-feed hit rate in [0, 1] for --vis "
+             "(default 0.0: every dispatch reads through spill)",
+    )
+    ap.add_argument(
+        "--vis-grid", action="store_true",
+        help="also price the adjoint vis.grid accumulation into the "
+             "--vis wall (the gridding ingest workload)",
     )
     ap.add_argument(
         "--colpass", action="store_true",
@@ -246,6 +272,23 @@ def main(argv=None):
             "  note: the table only RANKS — resolve_colpass keeps the "
             "choice (SWIFTLY_COLPASS env, platform, backend)"
         )
+        return 0
+    if args.vis is not None:
+        from swiftly_tpu.plan import price_vis
+
+        try:
+            vplan = price_vis(
+                args.vis, subgrid_size=inputs.xA,
+                cache_hit_rate=args.vis_hit_rate,
+                include_grid=args.vis_grid, coeffs=coeffs,
+            )
+        except ValueError as exc:
+            print(exc.args[0], file=sys.stderr)
+            return 2
+        if args.as_json:
+            print(json.dumps(vplan.as_dict(), indent=2))
+        else:
+            print(vplan.explain())
         return 0
     if args.delta is not None:
         try:

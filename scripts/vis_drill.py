@@ -1,0 +1,107 @@
+"""Visibility drill CLI: replay a zipf (u, v) workload and audit it.
+
+The operator's front door to visibility-space serving
+(docs/visibility.md): runs `bench.vis_bench` — a zipf-over-(u, v)
+sample workload through `swiftly_tpu.vis.VisibilityService` (samples
+split by owning subgrid, coalesced by column through the serve
+admission machinery, answered by one degrid dispatch per touched
+subgrid off cache-fed or computed rows) with the drills folded in: an
+admission-queue overload burst, a forced spill eviction (cache →
+compute fallback), a boundary-straddling batch shed ``outside_cover``,
+and a facet update after which the version-pinned gridder refuses
+stale-era batches. Every served sample is audited against the
+direct-DFT oracle and bit-compared against a fresh forward; the
+gridded batch round-trips into `StreamedBackward.add_subgrid_group`.
+
+Usage:
+    python scripts/vis_drill.py                       # n256 smoke scale
+    python scripts/vis_drill.py --samples 8000 --max-batch 32
+    python scripts/vis_drill.py --swift_config 1k[1]-n512-256
+
+The artifact's ``vis`` block records latency quantiles, shed /
+coalesce / cache rates, the oracle RMS, the adjoint identity, the
+gridding round-trip and the priced dispatch plan —
+`scripts/bench_compare.py` sentinels ``vis.p99_ms`` and
+``vis.throughput_ksamples_s`` against prior vis artifacts, and
+`scripts/plan_explain.py --vis` prints the priced batch table.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="zipf (u, v) visibility-serving drill: degrid off "
+        "served subgrid rows with overload/eviction/stale-version "
+        "faults, audited against the direct-DFT oracle"
+    )
+    ap.add_argument("--swift_config", default="",
+                    help="catalogue config name (default: the built-in "
+                    "n256 smoke geometry)")
+    ap.add_argument("--samples", type=int, default=2000,
+                    help="zipf workload size (default 2000)")
+    ap.add_argument("--depth", type=int, default=64,
+                    help="admission queue depth (default 64)")
+    ap.add_argument("--max-batch", type=int, default=16, dest="max_batch",
+                    help="scheduler coalescing cap (default 16)")
+    ap.add_argument("--zipf-s", type=float, default=1.1, dest="zipf_s",
+                    help="zipf exponent over columns (default 1.1)")
+    ap.add_argument("--slo-ms", type=float, default=30000.0, dest="slo_ms",
+                    help="per-request latency SLO in ms (default 30000)")
+    ap.add_argument("--seed", type=int, default=1234)
+    ap.add_argument("--out", default="BENCH_vis.json",
+                    help="artifact path (default BENCH_vis.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the drill outcomes (nonzero exit on "
+                    "any failed audit), not just the schema")
+    ap.add_argument("--loglevel", default="INFO")
+    args = ap.parse_args()
+
+    logging.basicConfig(
+        level=args.loglevel,
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    os.environ["BENCH_VIS_OUT"] = args.out
+    os.environ["BENCH_VIS_CONFIG"] = args.swift_config
+    os.environ["BENCH_VIS_SAMPLES"] = str(args.samples)
+    os.environ["BENCH_VIS_DEPTH"] = str(args.depth)
+    os.environ["BENCH_VIS_MAX_BATCH"] = str(args.max_batch)
+    os.environ["BENCH_VIS_ZIPF_S"] = str(args.zipf_s)
+    os.environ["BENCH_VIS_SLO_MS"] = str(args.slo_ms)
+    os.environ["BENCH_VIS_SEED"] = str(args.seed)
+
+    import bench
+
+    # vis_bench owns metrics enablement, artifact stamping, the oracle
+    # / adjoint / bit-identity audits, schema validation and the
+    # summary line; the CLI just parameterises it
+    rc = bench.vis_bench(smoke_mode=args.smoke)
+    if rc == 0:
+        log = logging.getLogger("vis-drill")
+        with open(args.out) as fh:
+            v = json.load(fh)["vis"]
+        log.info(
+            "vis served: %d/%d samples, p50 %.1fms p99 %.1fms, "
+            "%.2f ksamples/s (%.0fx the subgrid-serving rate), "
+            "oracle rms %.2e (tol %.0e), adjoint %.2e, "
+            "%d gridded -> ingested=%s, stale gridder refused=%s",
+            v["n_served_samples"], v["n_samples"],
+            v["p50_ms"], v["p99_ms"], v["throughput_ksamples_s"],
+            v["serve_baseline"]["ratio"], v["degrid_rms"],
+            v["kernel"]["tolerance"], v["adjoint"]["rel_err"],
+            v["grid"]["n_gridded"], v["grid"]["ingested"],
+            v["grid"]["stale_refused"],
+        )
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,7 @@ from .manifest import (
     validate_plan_artifact,
     validate_resilience_artifact,
     validate_serve_artifact,
+    validate_vis_artifact,
 )
 from .report import summarize_trace, validate_trace_artifact
 from .tower import (
@@ -78,18 +79,19 @@ __all__ = [
     "recorder",
     "report",
     "run_manifest",
-    "tower",
     "summarize_trace",
+    "tower",
     "trace",
     "validate_alerts_artifact",
     "validate_artifact",
     "validate_delta_artifact",
-    "validate_fleet_telemetry_artifact",
     "validate_fleet_artifact",
+    "validate_fleet_telemetry_artifact",
     "validate_mesh_artifact",
     "validate_plan_accuracy_artifact",
     "validate_plan_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
     "validate_trace_artifact",
+    "validate_vis_artifact",
 ]

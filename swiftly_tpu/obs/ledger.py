@@ -100,6 +100,12 @@ PLAN_STAGE_TIMERS = {
     "fwd.replay": ("fwd.replay",),
     "mesh.psum": ("mesh.psum",),
     "mesh.ring_step": ("mesh.ring_step",),
+    # visibility serving (plan.vis.price_vis): every stage records
+    # under its priced name (the row fetch's hit/miss tier split is
+    # blended into one priced wall at the expected hit rate)
+    "vis.degrid": ("vis.degrid",),
+    "vis.grid": ("vis.grid",),
+    "vis.row_fetch": ("vis.row_fetch",),
 }
 
 # Runtime timers deliberately OUTSIDE the priced model, each with its

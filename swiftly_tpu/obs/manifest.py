@@ -228,6 +228,11 @@ def validate_serve_artifact(record):
     SLO metric block, with rates in [0, 1] and a coherent latency
     ordering — schema drift in the serving telemetry fails in seconds
     on CPU, not in a production latency regression nobody can read.
+
+    One of the serving-family validators (`validate_serve_artifact`,
+    `validate_fleet_artifact`, `validate_vis_artifact`): all three
+    share the manifest + latency-ordering + bit-identity checks and
+    differ in the workload block they enforce.
     """
     problems = validate_artifact(record, require_baseline=False)
     for field in SERVE_ARTIFACT_FIELDS:
@@ -275,6 +280,128 @@ def validate_serve_artifact(record):
     return problems
 
 
+# The visibility block every `bench.py --vis` artifact must carry
+# (`VisibilityService.stats()` plus the accuracy/adjoint/grid audits)
+# — the visibility-serving schema contract, guarded by the --vis
+# --smoke leg like the serve/fleet families above.
+VIS_ARTIFACT_FIELDS = (
+    "p50_ms",
+    "p99_ms",
+    "shed_rate",
+    "coalesce_hit_rate",
+    "throughput_ksamples_s",
+    "n_requests",
+    "n_samples",
+    "n_served_samples",
+    "degrid_rms",
+    "kernel",
+    "adjoint",
+    "grid",
+)
+
+
+def validate_vis_artifact(record):
+    """Problems with a vis-mode BENCH artifact, as a list of strings.
+
+    Visibility legs are audited against the direct-DFT oracle instead
+    of a numpy baseline race, so beyond the manifest + SLO checks of
+    the serve family this validator enforces the ACCURACY contract:
+    ``degrid_rms`` within the stamped kernel's ``tolerance``
+    (`vis.kernel.DEGRID_TOLERANCE`), the adjoint dot-product identity
+    within its own tolerance, and a gridding block showing the batch
+    round-tripped into the backward ingest — a vis artifact that
+    serves fast but wrong must fail validation, not ship.
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    vis = record.get("vis")
+    if not isinstance(vis, dict):
+        problems.append("missing vis block")
+        return problems
+    for field in VIS_ARTIFACT_FIELDS:
+        if field not in vis:
+            problems.append(f"missing vis field {field!r}")
+    for rate in ("shed_rate", "coalesce_hit_rate"):
+        v = vis.get(rate)
+        if v is not None and not (0.0 <= v <= 1.0):
+            problems.append(f"vis {rate} {v!r} outside [0, 1]")
+    p50, p99 = vis.get("p50_ms"), vis.get("p99_ms")
+    if (
+        isinstance(p50, (int, float))
+        and isinstance(p99, (int, float))
+        and p99 < p50
+    ):
+        problems.append(f"vis p99_ms {p99} < p50_ms {p50}")
+    if vis.get("n_served_samples") and not vis.get(
+        "throughput_ksamples_s"
+    ):
+        problems.append("served samples but no throughput_ksamples_s")
+    kernel = vis.get("kernel")
+    if not isinstance(kernel, dict) or not (
+        {"support", "oversample", "band", "tolerance"} <= set(kernel)
+    ):
+        problems.append(
+            "missing kernel {support, oversample, band, tolerance} "
+            "block"
+        )
+        kernel = {}
+    rms = vis.get("degrid_rms")
+    tol = kernel.get("tolerance")
+    if (
+        isinstance(rms, (int, float))
+        and isinstance(tol, (int, float))
+        and rms > tol
+    ):
+        problems.append(
+            f"degrid_rms {rms} exceeds the kernel tolerance {tol}"
+        )
+    adjoint = vis.get("adjoint")
+    if not isinstance(adjoint, dict) or not (
+        {"rel_err", "tolerance"} <= set(adjoint)
+    ):
+        problems.append("missing adjoint {rel_err, tolerance} block")
+    elif adjoint["rel_err"] > adjoint["tolerance"]:
+        problems.append(
+            f"adjoint rel_err {adjoint['rel_err']} exceeds "
+            f"{adjoint['tolerance']}"
+        )
+    grid = vis.get("grid")
+    if not isinstance(grid, dict) or not (
+        {"n_gridded", "ingested"} <= set(grid)
+    ):
+        problems.append("missing grid {n_gridded, ingested} block")
+    elif grid.get("n_gridded") and not grid.get("ingested"):
+        problems.append(
+            "gridded samples never ingested into the backward "
+            "(add_subgrid_group round-trip missing)"
+        )
+    bit = record.get("bit_identical")
+    if not isinstance(bit, dict) or not (
+        {"checked", "mismatches"} <= set(bit)
+    ):
+        problems.append(
+            "missing bit_identical {checked, mismatches} block"
+        )
+    journey = vis.get("journey")
+    if isinstance(journey, dict):
+        shares = [
+            journey[seg]["share"]
+            for seg in ("queue", "compute", "transfer")
+            if isinstance(journey.get(seg), dict)
+            and "share" in journey[seg]
+        ]
+        if len(shares) != 3:
+            problems.append(
+                "vis journey block missing queue/compute/transfer "
+                "segments"
+            )
+        elif not 0.99 <= sum(shares) <= 1.01:
+            problems.append(
+                f"vis journey segment shares sum to {sum(shares)}, "
+                "not 1"
+            )
+    return problems
+
+
 # The fleet block every `bench.py --fleet` artifact must carry — the
 # self-healing serve drill's schema contract: the kill/restore cycle
 # (replica deaths, failovers, restores), the full breaker cycle, the
@@ -313,7 +440,8 @@ def validate_fleet_artifact(record):
     cycle, a per-replica QPS table covering the whole fleet,
     ``zero_lost`` True and a clean bit-identity audit — a failover
     drill that dropped or corrupted a request is a correctness bug,
-    not an availability result.
+    not an availability result. Serving-family sibling of
+    `validate_serve_artifact` / `validate_vis_artifact`.
     """
     problems = validate_artifact(record, require_baseline=False)
     for field in FLEET_ARTIFACT_FIELDS:

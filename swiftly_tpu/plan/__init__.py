@@ -60,6 +60,7 @@ from .model import (
     projected_column_bytes,
     projected_request_bytes,
 )
+from .vis import VisPlan, price_vis
 
 __all__ = [
     "BackwardPlan",
@@ -71,6 +72,7 @@ __all__ = [
     "PlanInputs",
     "ServePlan",
     "SpillPolicy",
+    "VisPlan",
     "autotune",
     "bucket_shape",
     "bucket_sizes",
@@ -86,6 +88,7 @@ __all__ = [
     "price_cache_tier",
     "price_collective_candidates",
     "price_colpass_candidates",
+    "price_vis",
     "projected_column_bytes",
     "projected_request_bytes",
     "refit_from_ledger",

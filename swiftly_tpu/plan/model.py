@@ -365,6 +365,14 @@ _DEFAULT_FLOPS_PER_S = {
     "bwd.column_pass.pallas": 12e12,
     "bwd.sampled_fold": 9e12,
     "bwd": 9e12,
+    # visibility degrid/grid: gather/scatter plus a [B, W, W]
+    # contraction — VPU work with data-dependent addressing, nowhere
+    # near MXU rates. Coarse anchors that RANK bucket candidates in
+    # `plan.vis.price_vis`; the stages record attributed flops under
+    # the same names, so `plan.autotune.refit` supersedes them from
+    # the first recorded `bench.py --vis` artifact
+    "vis.degrid": 2e12,
+    "vis.grid": 1e12,
 }
 _DEFAULT_BYTES_PER_S = {
     "spill.h2d": 6e9,

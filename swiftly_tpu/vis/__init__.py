@@ -1,0 +1,55 @@
+"""Visibility-space serving: degrid/grid as the product surface.
+
+The serving stack answers *subgrid* requests; this package turns those
+rows into the quantity interferometry clients actually consume —
+visibility samples at arbitrary fractional (u, v) — and back:
+
+* `vis.kernel` — PSWF-derived separable degridding kernel + image-
+  plane grid correction (host-side precompute, accuracy contract
+  ``DEGRID_TOLERANCE``);
+* `vis.mapping` — sample -> owning-subgrid index over the served
+  cover (outside-cover samples are shed, never answered wrong);
+* `vis.degrid` — the jitted gather + contraction batch body (einsum
+  by default, fused Pallas behind ``SWIFTLY_PALLAS``);
+* `vis.grid` — the exact adjoint scatter + the version-pinned
+  `VisGridder` accumulator feeding
+  `parallel.streamed.StreamedBackward.add_subgrid_group`;
+* `vis.service` — `VisibilityService`, the product surface: admission
+  / coalescing / cache-feed / compute-fallback / facet-update
+  version gates, all shared with `serve`;
+* `vis.oracle` — direct-DFT reference for accuracy audits.
+
+See docs/visibility.md for the end-to-end story.
+"""
+
+from .kernel import DEGRID_TOLERANCE, MAX_BAND, VisKernel, vis_kernel
+from .mapping import VisCoverIndex
+from .degrid import bucket_size, degrid_batch, split_row_planes
+from .grid import ADJOINT_TOLERANCE, VisGridder, grid_batch
+from .oracle import corrected_sources, vis_oracle
+from .service import (
+    FleetRowSource,
+    VisHandle,
+    VisRequest,
+    VisibilityService,
+)
+
+__all__ = [
+    "ADJOINT_TOLERANCE",
+    "DEGRID_TOLERANCE",
+    "MAX_BAND",
+    "FleetRowSource",
+    "VisCoverIndex",
+    "VisGridder",
+    "VisHandle",
+    "VisKernel",
+    "VisRequest",
+    "VisibilityService",
+    "bucket_size",
+    "corrected_sources",
+    "degrid_batch",
+    "grid_batch",
+    "split_row_planes",
+    "vis_kernel",
+    "vis_oracle",
+]

@@ -1,0 +1,210 @@
+"""Gridding: the exact adjoint of `vis.degrid`, feeding the backward.
+
+``grid_batch`` scatter-adds each weighted visibility into its
+``support x support`` patch — the transpose of the degrid gather with
+the SAME indices and the SAME real weights, so the dot-product identity
+
+    < degrid(G), y >  ==  < G, grid(y) >
+
+holds to float accumulation order (pinned by tests/test_vis.py).
+
+`VisGridder` is the streaming accumulator on top: visibility batches
+accumulate into per-subgrid planes, version-pinned against the serving
+stream (a facet update moves the stream version and the gridder REFUSES
+further batches — gridding v-era samples into a v+1 image would corrupt
+the update, the same stale-read rule `parallel.streamed
+.CachedColumnFeed` enforces on reads). ``emit()`` hands the accumulated
+columns over in `StreamedBackward.add_subgrid_group` form — subgrid
+columns stacked ``[G, S, xA, xA(, 2)]`` — so gridded visibilities are an
+ingest source for the backward/delta path with no adapter in between.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+
+__all__ = ["ADJOINT_TOLERANCE", "VisGridder", "grid_batch"]
+
+# Bound on | <degrid(G), y> - <G, grid(y)> | / |<degrid(G), y>| — the
+# dot-product identity holds exactly in exact arithmetic; float32
+# accumulation (the engine's serving dtype, x64 stays off) leaves
+# reordering noise that cancellation in the batched dot products can
+# inflate to ~1e-5, so 1e-4 still catches a real adjoint bug (those
+# miss by O(1)) while never flaking on rounding.
+ADJOINT_TOLERANCE = 1e-4
+
+
+@functools.lru_cache(maxsize=None)
+def _grid_fn(support):
+    import jax
+    import jax.numpy as jnp
+
+    offs = jnp.arange(support)
+
+    def body(acc_r, acc_i, iu0, iv0, cu, cv, yr, yi):
+        iu = iu0[:, None] + offs
+        iv = iv0[:, None] + offs
+        w2 = cu[:, :, None] * cv[:, None, :]  # [B, W, W]
+        idx = (iu[:, :, None], iv[:, None, :])
+        acc_r = acc_r.at[idx].add(yr[:, None, None] * w2)
+        acc_i = acc_i.at[idx].add(yi[:, None, None] * w2)
+        return acc_r, acc_i
+
+    return jax.jit(body)
+
+
+def grid_batch(size, iu0, iv0, cu, cv, vis, acc=None, dtype=np.float32):
+    """Scatter one visibility batch into a [size, size] grid plane pair.
+
+    :param vis: [B] complex visibilities (sample weights fold in here)
+    :param acc: optional (real, imag) planes to accumulate into
+    :return: (real, imag) float planes — callers view them complex or
+        stack them planar as their backend needs
+    """
+    n = int(np.asarray(iu0).size)
+    W = int(cu.shape[1])
+    if acc is None:
+        acc_r = np.zeros((size, size), dtype=dtype)
+        acc_i = np.zeros((size, size), dtype=dtype)
+    else:
+        acc_r, acc_i = acc
+    vis = np.asarray(vis, dtype=complex)
+    fn = _grid_fn(W)
+    out_r, out_i = fn(
+        np.asarray(acc_r),
+        np.asarray(acc_i),
+        np.asarray(iu0, dtype=np.int32),
+        np.asarray(iv0, dtype=np.int32),
+        np.asarray(cu, dtype=acc_r.dtype),
+        np.asarray(cv, dtype=acc_r.dtype),
+        vis.real.astype(dtype),
+        vis.imag.astype(dtype),
+    )
+    return np.asarray(out_r), np.asarray(out_i)
+
+
+class VisGridder:
+    """Version-pinned visibility -> subgrid-column accumulator.
+
+    :param cover_index: `vis.mapping.VisCoverIndex` over the served
+        cover (sharing the service's index keeps grid and degrid on the
+        same ownership rule)
+    :param kernel: `vis.kernel.VisKernel`
+    :param stream_version: the facet-stack version these visibilities
+        belong to — pin it from `VisibilityService.stream_version` at
+        construction
+    :param version_of: zero-arg callable returning the CURRENT stream
+        version (e.g. ``lambda: service.stream_version``); when it
+        moves past the pinned version, `add_batch` raises LookupError
+    :param dtype: accumulator real dtype (match the backward core's)
+    """
+
+    def __init__(self, cover_index, kernel, stream_version=0,
+                 version_of=None, dtype=np.float32):
+        self.cover = cover_index
+        self.kernel = kernel
+        self.stream_version = int(stream_version)
+        self._version_of = version_of
+        self.dtype = np.dtype(dtype)
+        self._acc = {}  # (off0, off1) -> (real, imag) planes
+        self.n_gridded = 0
+        self.n_shed = 0
+        self.batches = 0
+
+    def _gate(self):
+        if self._version_of is None:
+            return
+        current = int(self._version_of())
+        if current != self.stream_version:
+            raise LookupError(
+                f"gridder pinned at stream version "
+                f"{self.stream_version} but the serving stream moved "
+                f"to {current} (a facet update landed); gridding "
+                "stale-era samples would corrupt the updated image — "
+                "re-pin a fresh VisGridder"
+            )
+
+    def add_batch(self, uv, vis, weights=None):
+        """Accumulate one weighted visibility batch.
+
+        :param uv: [B, 2] sample coordinates
+        :param vis: [B] complex visibilities
+        :param weights: optional [B] real sample weights
+        :return: number of samples gridded (outside-cover samples are
+            counted in ``n_shed`` and skipped, mirroring the degrid
+            shed rule)
+        :raises LookupError: when the pinned stream version is stale
+        """
+        self._gate()
+        uv = np.atleast_2d(np.asarray(uv, dtype=float))
+        vis = np.asarray(vis, dtype=complex)
+        if weights is not None:
+            vis = vis * np.asarray(weights, dtype=float)
+        owners, shed = self.cover.map_samples(uv)
+        self.n_shed += len(shed)
+        gridded = 0
+        for (off0, off1), entry in owners.items():
+            sg = self.cover.config(off0, off1)
+            cu = self.kernel.weights(entry["fu"], dtype=self.dtype)
+            cv = self.kernel.weights(entry["fv"], dtype=self.dtype)
+            acc = self._acc.get((off0, off1))
+            B, W = cu.shape
+            # attributed exactly as plan.price_vis prices the stage
+            # (two scattered planes + the weight outer product), so
+            # plan.autotune.refit recovers a measured vis.grid rate
+            with _metrics.stage(
+                "vis.grid",
+                flops=8 * B * W * W,
+                bytes_moved=2 * B * W * W * 4,
+            ):
+                self._acc[(off0, off1)] = grid_batch(
+                    sg.size, entry["iu0"], entry["iv0"], cu, cv,
+                    vis[entry["idx"]], acc=acc, dtype=self.dtype,
+                )
+            gridded += len(entry["idx"])
+        self.n_gridded += gridded
+        self.batches += 1
+        return gridded
+
+    def subgrid(self, off0, off1):
+        """One accumulated plane pair as a complex array (tests)."""
+        acc_r, acc_i = self._acc[(off0, off1)]
+        return acc_r + 1j * acc_i
+
+    def emit(self, planar=True):
+        """The accumulated columns in `StreamedBackward
+        .add_subgrid_group` form.
+
+        :param planar: stack ``[..., 2]`` real/imag planes (the planar
+            backward core's layout); False keeps complex rows
+        :return: ``(col_sg_lists, subgrids_group)`` — per-column config
+            lists (one shared off0 each, trailing rows zero-padded by
+            the consumer's contract) and the ``[G, S, size, size(, 2)]``
+            stacked array
+        """
+        if not self._acc:
+            raise ValueError("nothing gridded yet")
+        cols = {}
+        for (off0, off1) in sorted(self._acc):
+            cols.setdefault(off0, []).append(off1)
+        S = max(len(v) for v in cols.values())
+        col_sg_lists, stacks = [], []
+        for off0, off1s in cols.items():
+            sgs = [self.cover.config(off0, o1) for o1 in off1s]
+            col_sg_lists.append(sgs)
+            rows = []
+            for o1 in off1s:
+                acc_r, acc_i = self._acc[(off0, o1)]
+                if planar:
+                    rows.append(np.stack([acc_r, acc_i], axis=-1))
+                else:
+                    rows.append(acc_r + 1j * acc_i)
+            pad = S - len(rows)
+            if pad:
+                rows += [np.zeros_like(rows[0])] * pad
+            stacks.append(np.stack(rows))
+        return col_sg_lists, np.stack(stacks)

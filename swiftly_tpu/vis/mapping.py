@@ -1,0 +1,152 @@
+"""Sample -> owning-subgrid mapping for visibility serving.
+
+A degrid sample at fractional (u, v) needs a ``support x support``
+patch of integer grid pixels around it, all inside ONE served subgrid
+(and inside that subgrid's mask-1 region — masked-out border pixels
+are zeros, not grid values). `VisCoverIndex` precomputes, per axis,
+the sorted span table of the subgrid cover and answers, per sample:
+
+* the owning ``(off0, off1)`` subgrid and the patch's first-tap index
+  into its rows, or
+* *outside_cover* — the patch straddles a subgrid boundary (or falls
+  off the cover / into a masked border). Those samples are SHED with
+  ``shed_reason="outside_cover"`` (`vis.service`), never answered
+  wrong: the cover's column overlap is a deployment choice, and the
+  structured shed tells the operator which margin to widen.
+
+Coordinates are grid pixels (the subgrid axes of
+`ops.oracle.make_subgrid_from_sources`: column ``off`` spans
+``[off - size/2, off + size/2)``), periodic in N; inputs are
+canonicalised into the cover's principal window first.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["VisCoverIndex"]
+
+
+def _axis_spans(offs, sizes, masks):
+    """Sorted (lo, hi_exclusive, off, mask_lo, mask_hi) spans for one
+    axis of the cover; the mask bounds are the contiguous mask-1 run
+    (full covers are all-ones -> the whole span)."""
+    spans = []
+    for off, size, mask in zip(offs, sizes, masks):
+        lo = off - size // 2
+        m_lo, m_hi = lo, lo + size
+        if mask is not None:
+            m = np.asarray(mask)
+            ones = np.flatnonzero(m != 0)
+            if ones.size == 0:
+                continue
+            m_lo = lo + int(ones[0])
+            m_hi = lo + int(ones[-1]) + 1
+        spans.append((lo, lo + size, int(off), m_lo, m_hi))
+    spans.sort()
+    return spans
+
+
+class VisCoverIndex:
+    """Owning-subgrid lookup over a subgrid cover.
+
+    :param subgrid_configs: the cover (`models.covers
+        .make_full_subgrid_cover` or any SubgridConfig list)
+    :param support: kernel tap count (`vis.kernel.VisKernel.support`)
+    :param N: grid period (``config.image_size``) for canonicalisation
+    """
+
+    def __init__(self, subgrid_configs, support, N):
+        self.support = int(support)
+        self.N = int(N)
+        self.taps_lo = -(self.support // 2 - 1)
+        self.taps_hi = self.support // 2  # inclusive
+        by_key = {}
+        for sg in subgrid_configs:
+            by_key[(sg.off0, sg.off1)] = sg
+        self._configs = by_key
+        offs0 = sorted({sg.off0 for sg in subgrid_configs})
+        offs1 = sorted({sg.off1 for sg in subgrid_configs})
+        sg0 = {sg.off0: sg for sg in subgrid_configs}
+        sg1 = {sg.off1: sg for sg in subgrid_configs}
+        self._spans_u = _axis_spans(
+            offs0,
+            [sg0[o].size for o in offs0],
+            [sg0[o].mask0 for o in offs0],
+        )
+        self._spans_v = _axis_spans(
+            offs1,
+            [sg1[o].size for o in offs1],
+            [sg1[o].mask1 for o in offs1],
+        )
+        if not self._spans_u or not self._spans_v:
+            raise ValueError("empty subgrid cover")
+        # principal window: [first span lo, first span lo + N)
+        self._win_lo = self._spans_u[0][0]
+
+    def config(self, off0, off1):
+        return self._configs[(off0, off1)]
+
+    def canonicalise(self, uv):
+        """(u, v) folded into the cover's principal window (period N)."""
+        uv = np.asarray(uv, dtype=float)
+        return (uv - self._win_lo) % self.N + self._win_lo
+
+    def _owner_1d(self, spans, x0):
+        """Axis owner of integer first-pixel coordinate ``x0`` whose
+        taps span [x0, x0 + support); None when the patch crosses a
+        span (or mask) boundary."""
+        pat_lo = x0 + 0  # first tap
+        pat_hi = x0 + self.support - 1  # last tap, inclusive
+        # linear scan is fine: covers hold O(10) columns per axis; a
+        # bisect would save nothing at these sizes
+        for (lo, hi, off, m_lo, m_hi) in spans:
+            if pat_lo >= m_lo and pat_hi < m_hi:
+                return off, lo
+        return None
+
+    def map_samples(self, uv):
+        """Partition a sample batch by owning subgrid.
+
+        :param uv: [B, 2] fractional grid coordinates
+        :return: ``(owners, shed_idx)`` — ``owners`` maps
+            ``(off0, off1) -> dict`` with ``idx`` (input indices),
+            ``iu0``/``iv0`` (first-tap row indices into the owning
+            subgrid), ``fu``/``fv`` (sub-pixel fractions in [0, 1));
+            ``shed_idx`` the outside-cover input indices
+        """
+        uv = self.canonicalise(np.atleast_2d(uv))
+        u0 = np.floor(uv[:, 0]).astype(int)
+        v0 = np.floor(uv[:, 1]).astype(int)
+        fu = uv[:, 0] - u0
+        fv = uv[:, 1] - v0
+        owners, shed = {}, []
+        for b in range(uv.shape[0]):
+            first_u = u0[b] + self.taps_lo
+            first_v = v0[b] + self.taps_lo
+            own_u = self._owner_1d(self._spans_u, first_u)
+            own_v = self._owner_1d(self._spans_v, first_v)
+            key = None
+            if own_u is not None and own_v is not None:
+                key = (own_u[0], own_v[0])
+                if key not in self._configs:
+                    key = None  # sparse cover: axis spans exist but
+                    # the (off0, off1) tile does not
+            if key is None:
+                shed.append(b)
+                continue
+            entry = owners.setdefault(
+                key,
+                {"idx": [], "iu0": [], "iv0": [], "fu": [], "fv": []},
+            )
+            entry["idx"].append(b)
+            entry["iu0"].append(first_u - own_u[1])
+            entry["iv0"].append(first_v - own_v[1])
+            entry["fu"].append(fu[b])
+            entry["fv"].append(fv[b])
+        for entry in owners.values():
+            for k in ("idx", "iu0", "iv0"):
+                entry[k] = np.asarray(entry[k], dtype=int)
+            for k in ("fu", "fv"):
+                entry[k] = np.asarray(entry[k], dtype=float)
+        return owners, shed

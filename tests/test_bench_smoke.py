@@ -818,6 +818,139 @@ def test_precision_budget_table_matches_docs():
         )
 
 
+def test_bench_vis_smoke_leg(tmp_path):
+    """The `bench.py --vis --smoke` leg (ISSUE-18 acceptance), run
+    exactly as the driver would — fresh subprocess, CPU: a zipf (u, v)
+    workload through `swiftly_tpu.vis.VisibilityService` with the
+    overload / forced-eviction / boundary-shed / facet-update drills
+    folded in, every served sample audited against the direct-DFT
+    oracle and bit-compared against a fresh forward, the gridded batch
+    round-tripped into `StreamedBackward.add_subgrid_group`, and the
+    served-samples throughput >= 10x the subgrid-serving request rate
+    — the ``vis`` artifact block through `obs.validate_vis_artifact`."""
+    out = tmp_path / "BENCH_vis.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_VIS_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--vis", "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["vis_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["serve_ratio"] >= 10.0
+
+    # re-validate the artifact out-of-process (the leg's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_vis_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_vis_artifact(record) == []
+    vis = record["vis"]
+    # accuracy: oracle RMS inside the kernel's stamped tolerance, the
+    # adjoint identity inside the float32-accumulation bound
+    assert vis["degrid_rms"] <= vis["kernel"]["tolerance"]
+    assert vis["adjoint"]["rel_err"] <= vis["adjoint"]["tolerance"]
+    # bit-discipline: every finite served sample matched a direct
+    # degrid off a fresh forward's rows, bit for bit
+    bits = record["bit_identical"]
+    assert bits["checked"] > 0 and bits["mismatches"] == 0
+    # the drills all fired, with structured reasons
+    assert vis["shed_reasons"]["depth"] > 0
+    assert vis["shed_reasons"]["outside_cover"] > 0
+    assert vis["cache_hits"] > 0 and vis["cache_fallbacks"] > 0
+    assert vis["coalesce_hit_rate"] > 0
+    assert vis["version_gate"]["gridder_refused"] is True
+    assert vis["version_gate"]["post_update_compute_only"] is True
+    # the gridded batch landed in the backward's ingest form
+    assert vis["grid"]["ingested"] is True
+    assert vis["grid"]["n_gridded"] > 0
+    # the throughput contract vs row serving
+    assert vis["serve_baseline"]["ratio"] >= 10.0
+    assert vis["throughput_ksamples_s"] > 0
+    # the priced dispatch plan joined the measured ledger
+    assert vis["plan"]["max_batch"] >= 16
+    acc = record["plan_accuracy"]
+    assert {"vis.degrid", "vis.grid", "vis.row_fetch"} <= set(
+        acc["stages"]
+    )
+    assert acc["uncovered"] == []
+    # telemetry carries the vis vocabulary
+    telemetry = record["telemetry"]
+    assert {"vis.degrid", "vis.grid", "vis.row_fetch"} <= set(
+        telemetry["stages"]
+    )
+    assert telemetry["gauges_max"]["vis.queue_depth_peak"] >= 1
+    assert record["manifest"]["device"]["platform"] == "cpu"
+
+    # --- the vis sentinels (in-process: no extra spawn) ---------------
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_vis_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 0
+    # doctored 2x-faster-tail reference (wall unchanged: isolate the
+    # p99 leg) -> the vis.p99_ms sentinel must trip
+    doctored = json.loads(out.read_text())
+    doctored["vis"]["p99_ms"] = vis["p99_ms"] / 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 1
+    # doctored 2x-higher-throughput reference -> the
+    # vis.throughput_ksamples_s sentinel must trip
+    doctored = json.loads(out.read_text())
+    doctored["vis"]["throughput_ksamples_s"] = (
+        vis["throughput_ksamples_s"] * 2.0
+    )
+    ref.write_text(json.dumps(doctored))
+    assert compare_main([str(out), "--against", str(ref), "--json"]) == 1
+
+
+def test_compare_vis_sentinels_synthetic(tmp_path):
+    """The ``vis.p99_ms`` / ``vis.throughput_ksamples_s`` sentinels in
+    scripts/bench_compare.py on synthetic records (independent of the
+    subprocess leg above, so a sentinel wiring regression is caught
+    even if the leg's numbers drift): identical records stay green, a
+    tail-latency regression past the threshold trips, a within-
+    threshold drift stays green, and a throughput collapse trips."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    def rec(p99=40.0, ks=2.0):
+        return {
+            "metric": "vis-n256 visibility-serving wall-clock",
+            "value": 5.0,
+            "manifest": {
+                "config_params": {"config": "vis-n256", "mode": "vis"},
+                "device": {"platform": "cpu"},
+            },
+            "vis": {"p99_ms": p99, "throughput_ksamples_s": ks},
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    latest.write_text(json.dumps(rec()))
+    ref.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # p99 regressed >20% above the best reference -> trip
+    latest.write_text(json.dumps(rec(p99=60.0)))
+    assert compare_main(args) == 1
+    # within the threshold -> green (it is a threshold, not equality)
+    latest.write_text(json.dumps(rec(p99=45.0)))
+    assert compare_main(args) == 0
+    # throughput collapsed >20% below the best reference -> trip
+    latest.write_text(json.dumps(rec(ks=1.0)))
+    assert compare_main(args) == 1
+
+
 def _run_mesh_chaos(tmp_path, extra_args=(), timeout=540):
     out = tmp_path / "BENCH_mesh_chaos.json"
     env = dict(os.environ)

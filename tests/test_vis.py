@@ -1,0 +1,469 @@
+"""Visibility-space serving tests (`swiftly_tpu.vis`).
+
+The product-surface contract, pinned:
+
+* ACCURACY — degridded samples off served subgrid rows match the
+  direct-DFT oracle within ``DEGRID_TOLERANCE`` for band-limited,
+  grid-corrected sky models; `vis.grid` is the exact adjoint of
+  `vis.degrid` (dot-product identity within ``ADJOINT_TOLERANCE`` —
+  float32 accumulation noise, NOT a loose functional tolerance);
+* BIT-DISCIPLINE — cache-fed and compute-fallback rows yield
+  bit-identical samples, and a sample's bits do not depend on how its
+  batch was coalesced (per-lane einsum independence + the power-of-two
+  bucket floor of 2, `vis.degrid.bucket_size`);
+* STRUCTURED REFUSAL — samples whose kernel footprint straddles a
+  subgrid boundary shed with ``outside_cover``; a facet update bumps
+  the stream version, stale-stamped stragglers fall back to compute
+  against the CURRENT stack, and a version-pinned `VisGridder` refuses
+  stale-era batches outright;
+* COMPOSITION — `FleetRowSource` routes row fetches through a real
+  `serve.fleet.ServeFleet` without either side changing (slow-gated).
+"""
+
+import numpy as np
+import pytest
+
+from swiftly_tpu import (
+    SwiftlyConfig,
+    SwiftlyForward,
+    make_facet,
+    make_full_facet_cover,
+    make_full_subgrid_cover,
+)
+from swiftly_tpu.parallel.streamed import CachedColumnFeed
+from swiftly_tpu.serve import AdmissionQueue, CoalescingScheduler
+from swiftly_tpu.utils.spill import SpillCache
+from swiftly_tpu.vis import (
+    ADJOINT_TOLERANCE,
+    DEGRID_TOLERANCE,
+    MAX_BAND,
+    FleetRowSource,
+    VisCoverIndex,
+    VisGridder,
+    VisKernel,
+    VisibilityService,
+    bucket_size,
+    degrid_batch,
+    grid_batch,
+    vis_kernel,
+    vis_oracle,
+)
+
+# the known-good small geometry (real PSWF margin between yB and yN,
+# so served rows carry signal) — bench.py --vis uses the same set
+TEST_PARAMS = {
+    "W": 8.0,
+    "fov": 1.0,
+    "N": 256,
+    "yB_size": 96,
+    "yN_size": 128,
+    "xA_size": 56,
+    "xM_size": 64,
+}
+
+# integer pixel coordinates inside 0.9 x the kernel band edge
+# (band * N / 2 = 96 here): the fit error grows toward the boundary,
+# the margin keeps the oracle RMS well inside DEGRID_TOLERANCE
+SOURCES = [(1.0, 40, 20), (0.6, -30, 50), (0.3, 10, -60)]
+
+
+@pytest.fixture(scope="module")
+def vis_cover():
+    import jax.numpy as jnp
+
+    kernel = vis_kernel()
+    config = SwiftlyConfig(
+        backend="planar", dtype=jnp.float32, **TEST_PARAMS
+    )
+    N = config.image_size
+    corrected = kernel.correct_sources(SOURCES, N)
+    facet_configs = make_full_facet_cover(config)
+    facet_tasks = [
+        (fc, make_facet(N, fc, corrected)) for fc in facet_configs
+    ]
+    subgrid_configs = make_full_subgrid_cover(config)
+    return config, facet_tasks, subgrid_configs, kernel
+
+
+def _forward(vis_cover):
+    config, facet_tasks, _sgs, _k = vis_cover
+    return SwiftlyForward(
+        config, facet_tasks, lru_forward=2, queue_size=64
+    )
+
+
+def _service(vis_cover, fwd=None, **kwargs):
+    config, _tasks, sgs, kernel = vis_cover
+    if fwd is None:
+        fwd = _forward(vis_cover)
+    kwargs.setdefault("kernel", kernel)
+    return VisibilityService(fwd, subgrid_configs=sgs, **kwargs)
+
+
+def _interior_uv(sgs, kernel, n, seed=0):
+    """n guaranteed-in-cover samples: uniform in subgrid interiors,
+    rejection-filtered through the cover index (the overlap cover's
+    mask-1 runs are narrower than the spans, so a raw interior draw
+    can still straddle a mask edge)."""
+    rng = np.random.default_rng(seed)
+    index = VisCoverIndex(sgs, kernel.support, TEST_PARAMS["N"])
+    margin = kernel.support + 1
+    out = []
+    while len(out) < n:
+        sg = sgs[rng.integers(len(sgs))]
+        half = sg.size / 2.0 - margin
+        uv = np.array([[
+            sg.off0 + rng.uniform(-half, half),
+            sg.off1 + rng.uniform(-half, half),
+        ]])
+        _owners, shed = index.map_samples(uv)
+        if not shed:
+            out.append(uv[0])
+    return np.asarray(out)
+
+
+def _seed_feed(fwd, col_sgs):
+    """A cache feed holding one column's rows, recorded through the
+    SAME per-subgrid program the compute fallback uses."""
+    rows = [np.asarray(fwd.get_subgrid_task(sg)) for sg in col_sgs]
+    spill = SpillCache(budget_bytes=2**30)
+    spill.begin_fill(tag=("vis-test-seed", len(col_sgs)))
+    spill.put([list(enumerate(col_sgs))], np.stack(rows)[None])
+    spill.end_fill()
+    return CachedColumnFeed(spill)
+
+
+# ---------------------------------------------------------------------------
+# Kernel + mapping (host-side precompute, no forward needed)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_size_floor_and_powers():
+    """The jit-cache bucket discipline: powers of two, capped — and a
+    FLOOR of 2 (XLA compiles the B=1 einsum with a different reduction
+    order, which would break coalescing bit-identity)."""
+    assert bucket_size(0) == 2
+    assert bucket_size(1) == 2
+    assert bucket_size(2) == 2
+    assert bucket_size(3) == 4
+    assert bucket_size(17) == 32
+    assert bucket_size(10**9, max_bucket=4096) == 4096
+
+
+def test_kernel_weights_partition_of_unity_and_band():
+    k = vis_kernel()
+    # interpolation weights at frac 0 put the sample on a grid point:
+    # one dominant tap, the rest small
+    w0 = k.weights(np.array([0.0]), dtype=np.float64)[0]
+    assert np.argmax(np.abs(w0)) == k.support // 2 - 1
+    assert k.band <= MAX_BAND and k.tolerance == DEGRID_TOLERANCE
+    with pytest.raises(ValueError):
+        VisKernel(band=MAX_BAND + 0.1)
+
+
+def test_correct_sources_refuses_out_of_band():
+    k = vis_kernel()
+    N = 256
+    # inside the band: intensity divided by the separable taper
+    (w, x, y), = k.correct_sources([(1.0, 40, 20)], N)
+    assert (x, y) == (40, 20)
+    assert np.isclose(
+        w, 1.0 / (k.grid_correction(40, N) * k.grid_correction(20, N))
+    )
+    with pytest.raises(ValueError):
+        k.correct_sources([(1.0, int(k.band * N / 2) + 5, 0)], N)
+
+
+def test_cover_index_partitions_or_sheds(vis_cover):
+    """Every sample is owned by exactly one subgrid or shed — no
+    double-answers, no silent drops."""
+    _config, _tasks, sgs, kernel = vis_cover
+    N = TEST_PARAMS["N"]
+    index = VisCoverIndex(sgs, kernel.support, N)
+    rng = np.random.default_rng(3)
+    uv = rng.uniform(-N, 2 * N, size=(500, 2))  # canonicalisation too
+    owners, shed = index.map_samples(uv)
+    seen = sorted(
+        i for e in owners.values() for i in e["idx"]
+    ) + sorted(shed)
+    assert sorted(seen) == list(range(500))
+    for (off0, off1), entry in owners.items():
+        sg = index.config(off0, off1)
+        assert np.all(entry["iu0"] >= 0)
+        assert np.all(entry["iu0"] + kernel.support <= sg.size)
+        assert np.all((entry["fu"] >= 0) & (entry["fu"] < 1))
+
+
+# ---------------------------------------------------------------------------
+# Accuracy: oracle + adjoint
+# ---------------------------------------------------------------------------
+
+
+def test_degrid_matches_direct_dft_oracle(vis_cover):
+    """Served samples approximate the TRUE visibilities of the raw
+    (pre-correction) sky model within the kernel's stamped
+    tolerance."""
+    config, _tasks, sgs, kernel = vis_cover
+    svc = _service(vis_cover)
+    uv = _interior_uv(sgs, kernel, 96, seed=1)
+    handle = svc.serve(uv).wait(timeout=60)
+    assert handle.status == "ok", handle
+    ref = vis_oracle(SOURCES, uv, config.image_size)
+    rms = np.linalg.norm(handle.data - ref) / np.linalg.norm(ref)
+    assert rms <= DEGRID_TOLERANCE, rms
+
+
+def test_grid_is_exact_adjoint_of_degrid():
+    """< degrid(G), y > == < G, grid(y) > to float32 accumulation
+    order — the SAME indices and the SAME real weights, transposed.
+    ADJOINT_TOLERANCE is rounding headroom (x64 stays off on the
+    serving path), not functional slack: a real adjoint bug misses by
+    O(1)."""
+    k = vis_kernel()
+    rng = np.random.default_rng(7)
+    size, B, W = 56, 64, k.support
+    row = rng.standard_normal((size, size, 2)).astype(np.float32)
+    iu0 = rng.integers(0, size - W, size=B)
+    iv0 = rng.integers(0, size - W, size=B)
+    cu = k.weights(rng.uniform(0, 1, size=B), dtype=np.float32)
+    cv = k.weights(rng.uniform(0, 1, size=B), dtype=np.float32)
+    y = (
+        rng.standard_normal(B) + 1j * rng.standard_normal(B)
+    ).astype(np.complex64)
+    d = degrid_batch(row, iu0, iv0, cu, cv)
+    lhs = np.vdot(d, y)
+    gr, gi = grid_batch(size, iu0, iv0, cu, cv, y)
+    plane = (row[..., 0] + 1j * row[..., 1]).astype(np.complex64)
+    rhs = np.vdot(plane, gr + 1j * gi)
+    rel = abs(lhs - rhs) / abs(lhs)
+    assert rel <= ADJOINT_TOLERANCE, rel
+
+
+# ---------------------------------------------------------------------------
+# Bit-discipline: cache vs compute, coalescing shapes
+# ---------------------------------------------------------------------------
+
+
+def test_cache_feed_and_compute_fallback_are_bit_identical(vis_cover):
+    """The serve tier's cache-vs-compute contract carries through to
+    samples: identical row bits in, identical sample bits out."""
+    _config, _tasks, sgs, kernel = vis_cover
+    hot_off0 = sorted({sg.off0 for sg in sgs})[0]
+    hot_col = [sg for sg in sgs if sg.off0 == hot_off0]
+    fwd = _forward(vis_cover)
+    feed = _seed_feed(fwd, hot_col)
+
+    uv = _interior_uv(hot_col, kernel, 24, seed=2)
+    cached = _service(vis_cover, fwd=fwd, cache_feed=feed)
+    h_cache = cached.serve(uv).wait(timeout=60)
+    assert h_cache.status == "ok"
+    assert cached.stats()["cache_hits"] > 0
+    assert cached.stats()["cache_fallbacks"] == 0
+
+    computed = _service(vis_cover)  # fresh forward, no feed
+    h_comp = computed.serve(uv).wait(timeout=60)
+    assert h_comp.status == "ok"
+    assert computed.stats()["cache_hits"] == 0
+    np.testing.assert_array_equal(h_cache.data, h_comp.data)
+
+
+def test_sample_bits_do_not_depend_on_coalescing(vis_cover):
+    """Two singleton submits coalesced into one dispatch == one
+    combined submit, bitwise — per-lane einsum independence plus the
+    bucket floor of 2 make batch shape a non-observable."""
+    _config, _tasks, sgs, kernel = vis_cover
+    sg = sgs[0]
+    uv = _interior_uv([sg], kernel, 2, seed=4)
+    fwd = _forward(vis_cover)
+
+    svc = _service(vis_cover, fwd=fwd)
+    h1 = svc.submit(uv[:1])
+    h2 = svc.submit(uv[1:])
+    while not (h1.done and h2.done):
+        assert svc.pump_once() or (h1.done and h2.done)
+    assert h1.status == "ok" and h2.status == "ok"
+    # both singletons answered by one coalesced dispatch
+    assert svc.stats()["n_batches"] == 1
+    assert svc.stats()["coalesce_hit_rate"] > 0
+
+    combined = _service(vis_cover, fwd=fwd)
+    hc = combined.serve(uv).wait(timeout=60)
+    assert hc.status == "ok"
+    np.testing.assert_array_equal(
+        np.concatenate([h1.data, h2.data]), hc.data
+    )
+
+
+# ---------------------------------------------------------------------------
+# Structured refusal: outside-cover, backpressure, version gates
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_straddling_sample_sheds_outside_cover(vis_cover):
+    """A footprint across a subgrid boundary is refused with a
+    structured reason — never answered wrong."""
+    _config, _tasks, sgs, kernel = vis_cover
+    svc = _service(vis_cover)
+    # first tap at the first u-span's mask-1 edge: the patch straddles
+    (_lo, _hi, _off, _m_lo, m_hi) = svc.cover._spans_u[0]
+    uv_bad = np.array([[m_hi + 0.5, sgs[0].off1 + 0.25]])
+    handle = svc.serve(uv_bad)
+    assert handle.done and handle.status == "shed"
+    assert handle.shed_reason == "outside_cover"
+    assert np.isnan(handle.data).all()
+    assert svc.stats()["shed_reasons"]["outside_cover"] == 1
+    # a mixed batch serves the good samples and flags the bad one
+    uv_good = _interior_uv([sgs[0]], kernel, 2, seed=5)
+    h = svc.serve(np.vstack([uv_good, uv_bad])).wait(timeout=60)
+    assert h.status == "partial" and h.shed_idx == [2]
+    assert np.isfinite(h.data[:2]).all() and np.isnan(h.data[2])
+
+
+def test_depth_overload_sheds_with_queue_reason(vis_cover):
+    _config, _tasks, sgs, kernel = vis_cover
+    svc = _service(
+        vis_cover, queue=AdmissionQueue(max_depth=4),
+        scheduler=CoalescingScheduler(max_batch=8),
+    )
+    uv = _interior_uv(sgs, kernel, 1, seed=6)
+    handles = [svc.submit(uv) for _ in range(10)]
+    shed = [h for h in handles if h.done and h.status == "shed"]
+    assert shed and all(h.shed_reason == "depth" for h in shed)
+    while svc.pump_once():
+        pass
+    assert all(h.done for h in handles)
+    assert svc.stats()["shed_reasons"]["depth"] == len(shed)
+    assert svc.stats()["n_served_samples"] == 10 - len(shed)
+
+
+def test_stale_version_straggler_falls_back_to_compute(vis_cover):
+    """A request admitted under a superseded facet stack must never be
+    answered off the old feed: it version-fallbacks onto the CURRENT
+    compute path (fresher than asked, never staler)."""
+    _config, _tasks, sgs, kernel = vis_cover
+    hot_off0 = sorted({sg.off0 for sg in sgs})[0]
+    hot_col = [sg for sg in sgs if sg.off0 == hot_off0]
+    fwd = _forward(vis_cover)
+    feed = _seed_feed(fwd, hot_col)
+    svc = _service(vis_cover, fwd=fwd, cache_feed=feed)
+    uv = _interior_uv(hot_col, kernel, 4, seed=8)
+    handle = svc.submit(uv)  # stamped with version 0, NOT pumped
+    svc.stream_version += 1  # the stack moves under it
+    while svc.pump_once():
+        pass
+    assert handle.done and handle.status == "ok"
+    st = svc.stats()
+    assert st["version_fallbacks"] > 0
+    assert st["cache_hits"] == 0  # the old feed was never consulted
+
+
+def test_facet_update_drops_feed_and_gridder_refuses(vis_cover):
+    """`post_facet_update` drains, DROPS the superseded feed, bumps
+    the version — and a `VisGridder` pinned to the old era refuses
+    further batches with LookupError."""
+    _config, _tasks, sgs, kernel = vis_cover
+    hot_off0 = sorted({sg.off0 for sg in sgs})[0]
+    hot_col = [sg for sg in sgs if sg.off0 == hot_off0]
+    fwd = _forward(vis_cover)
+    feed = _seed_feed(fwd, hot_col)
+    svc = _service(vis_cover, fwd=fwd, cache_feed=feed)
+    uv = _interior_uv(hot_col, kernel, 4, seed=9)
+    assert svc.serve(uv).wait(timeout=60).status == "ok"
+    hits_before = svc.stats()["cache_hits"]
+    assert hits_before > 0
+
+    gridder = VisGridder(
+        svc.cover, kernel,
+        stream_version=svc.stream_version,
+        version_of=lambda: svc.stream_version,
+    )
+    assert gridder.add_batch(uv, np.ones(4, dtype=complex)) == 4
+
+    v = svc.post_facet_update()  # no replacement feed: DROPPED
+    assert v == 1 and svc.cache_feed is None
+    with pytest.raises(LookupError):
+        gridder.add_batch(uv, np.ones(4, dtype=complex))
+    assert svc.serve(uv).wait(timeout=60).status == "ok"
+    # post-update serving is compute-only: no new cache hits
+    assert svc.stats()["cache_hits"] == hits_before
+    assert svc.stats()["facet_updates"] == 1
+
+
+def test_gridder_emit_matches_grid_batch(vis_cover):
+    """`emit()` hands the accumulated columns over in
+    `StreamedBackward.add_subgrid_group` form: per-column config
+    lists, [G, S, size, size, 2] planar stack, zero-padded rows."""
+    _config, _tasks, sgs, kernel = vis_cover
+    index = VisCoverIndex(sgs, kernel.support, TEST_PARAMS["N"])
+    gridder = VisGridder(index, kernel)
+    rng = np.random.default_rng(10)
+    uv = _interior_uv(sgs, kernel, 32, seed=10)
+    vis = rng.standard_normal(32) + 1j * rng.standard_normal(32)
+    assert gridder.add_batch(uv, vis) == 32
+    cols, stack = gridder.emit(planar=True)
+    assert stack.ndim == 5 and stack.shape[-1] == 2
+    assert stack.shape[0] == len(cols)
+    total = sum(len(c) for c in cols)
+    assert gridder.n_gridded == 32 and total >= 1
+    # each emitted plane matches the per-subgrid accumulator
+    sg0 = cols[0][0]
+    ref = gridder.subgrid(sg0.off0, sg0.off1)
+    np.testing.assert_array_equal(stack[0, 0, ..., 0], ref.real)
+    np.testing.assert_array_equal(stack[0, 0, ..., 1], ref.imag)
+
+
+# ---------------------------------------------------------------------------
+# Fleet composition (slow-gated: real replicas, worker threads)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_row_source_serves_bit_identical(vis_cover):
+    """`FleetRowSource` puts the fleet's whole resilience ladder under
+    visibility serving: samples served through a real 2-replica
+    `ServeFleet` are bit-identical to direct degrid off a fresh
+    forward's rows."""
+    from swiftly_tpu.serve import ServeFleet, SubgridService
+
+    config, facet_tasks, sgs, kernel = vis_cover
+
+    def factory(rid):
+        fwd = SwiftlyForward(
+            config, facet_tasks, lru_forward=2, queue_size=64
+        )
+        return SubgridService(
+            fwd, scheduler=CoalescingScheduler(max_batch=8)
+        )
+
+    fleet = ServeFleet(
+        factory, 2, lease_interval_s=0.05, miss_suspect=2,
+        miss_revoke=5, seed=11,
+    )
+    try:
+        fleet.start()
+        svc = VisibilityService(
+            subgrid_configs=sgs, N=config.image_size, kernel=kernel,
+            row_source=FleetRowSource(fleet, priority=1),
+        )
+        uv = _interior_uv(sgs, kernel, 16, seed=12)
+        handle = svc.serve(uv).wait(timeout=120)
+        assert handle.status == "ok", handle
+    finally:
+        fleet.stop()
+
+    fwd_ref = SwiftlyForward(
+        config, facet_tasks, lru_forward=2, queue_size=64
+    )
+    index = VisCoverIndex(sgs, kernel.support, config.image_size)
+    owners, shed = index.map_samples(uv)
+    assert not shed
+    for (off0, off1), e in owners.items():
+        row = np.asarray(
+            fwd_ref.get_subgrid_task(index.config(off0, off1))
+        )
+        ref = degrid_batch(
+            row, e["iu0"], e["iv0"],
+            kernel.weights(e["fu"], dtype=np.float64),
+            kernel.weights(e["fv"], dtype=np.float64),
+        )
+        np.testing.assert_array_equal(handle.data[e["idx"]], ref)
