@@ -3040,6 +3040,567 @@ def fleet_bench(smoke_mode=False):
     return 0 if not problems else 1
 
 
+def procfleet_bench(smoke_mode=False):
+    """`bench.py --procfleet [--smoke]`: the process-fleet SIGKILL drill.
+
+    Runs ``BENCH_PROCFLEET_WORKERS`` (default 3, 2 under ``--smoke``)
+    replicas as REAL OS processes behind `serve.ProcessFleet` — each a
+    spawned worker hosting a `SubgridService` over its own prepared
+    forward, speaking `serve.ipc`'s versioned length-prefixed frames,
+    serving the parent's recorded stream through the shared spill
+    directory (`SpillCache.export_manifest` → `SharedSpillReader` under
+    the unchanged `CachedColumnFeed` gates) — and lands two REAL
+    ``SIGKILL -9``s:
+
+    1. **before** — a clean zipf window; its p99 is the baseline.
+    2. **kill** — the same workload as a burst; mid-burst the hot
+       column's preferred worker is SIGKILLed. Its silent socket misses
+       lease beats → suspect → revoked; the breaker trips open; queued
+       + in-flight requests fail over to the survivors. ZERO requests
+       may be lost, and ``failover_ms`` (revocation → last failed-over
+       request served) is the artifact's headline value.
+    3. **restart** — the supervisor restarts the victim with capped
+       backoff; its breaker is NOT reset — victim-preferred traffic
+       drives the half-open probe path until the cycle reads
+       open → half_open → closed; a clean window pins p99 recovery.
+    4. **mid-L2-read kill** — a ``CONTROL`` frame arms a dwell inside
+       the second victim's next `SharedSpillReader.get_row` (the worker
+       announces the held mmap read via a flag file), and the SIGKILL
+       lands INSIDE that window: the failed-over row re-served by a
+       survivor must be bit-identical — entry files are immutable and
+       renamed into place, so a worker killed mid-read can never leave
+       a torn row for a survivor to observe.
+
+    Before any of that, fleet start exercises startup hygiene against
+    fabricated wreckage of a "crashed" previous run: a stale socket
+    file is swept and a live decoy worker process (cmdline-marker
+    matched, never pid alone) is reaped.
+
+    Every served result is audited BIT-IDENTICAL against its serving
+    path's reference — cache rows vs the parent's own recorded stream
+    (the exact bytes the workers mmap), compute results vs per-request
+    `get_subgrid_task` on a fresh forward — plus a cross-program
+    allclose guard against wrong-row serving. The artifact's
+    ``procfleet`` block is validated by
+    `obs.validate_procfleet_artifact`; with ``--smoke`` the drill
+    outcomes are asserted and the leg exits nonzero on any problem
+    (wired into tier-1 via tests/test_bench_smoke.py).
+    """
+    import signal
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from swiftly_tpu import (
+        SwiftlyConfig,
+        SwiftlyForward,
+        make_facet,
+        make_full_facet_cover,
+        make_full_subgrid_cover,
+    )
+    from swiftly_tpu.delta import IncrementalForward
+    from swiftly_tpu.models import SWIFT_CONFIGS
+    from swiftly_tpu.obs import (
+        metrics,
+        run_manifest,
+        validate_procfleet_artifact,
+    )
+    from swiftly_tpu.obs import trace as otrace
+    from swiftly_tpu.serve import ProcessFleet, make_worker_spec
+    from swiftly_tpu.serve.fleet import _rendezvous_score
+    from swiftly_tpu.utils import enable_compilation_cache
+    from swiftly_tpu.utils.spill import SpillCache, spill_budget_bytes
+
+    logging.basicConfig(
+        level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
+        format="%(asctime)s %(name)s: %(message)s",
+        stream=sys.stderr,
+    )
+    enable_compilation_cache()
+    trace_path = _maybe_enable_trace()
+    orecorder = _maybe_enable_recorder()
+    out_path = os.environ.get("BENCH_PROCFLEET_OUT", "BENCH_procfleet.json")
+    if smoke_mode:
+        os.environ.setdefault("SWIFTLY_PEAK_TFLOPS", "1.0")
+        metrics.enable(os.environ.get("SWIFTLY_METRICS_JSONL") or None)
+    name = os.environ.get("BENCH_PROCFLEET_CONFIG", "1k[1]-n512-256")
+    n_workers = int(os.environ.get(
+        "BENCH_PROCFLEET_WORKERS", "2" if smoke_mode else "3"))
+    per_phase = int(os.environ.get(
+        "BENCH_PROCFLEET_PHASE_REQUESTS", "16" if smoke_mode else "48"))
+    seed = int(os.environ.get("BENCH_PROCFLEET_SEED", "1234"))
+    zipf_s = float(os.environ.get("BENCH_PROCFLEET_ZIPF_S", "1.1"))
+    max_depth = int(os.environ.get("BENCH_PROCFLEET_DEPTH", "256"))
+    max_batch = int(os.environ.get("BENCH_PROCFLEET_MAX_BATCH", "16"))
+    dwell_s = float(os.environ.get("BENCH_PROCFLEET_DWELL_S", "1.5"))
+
+    params = dict(SWIFT_CONFIGS[name])
+    params.setdefault("fov", 1.0)
+    platform = jax.devices()[0].platform
+    config = SwiftlyConfig(backend="planar", dtype=jax.numpy.float32,
+                           **params)
+    facet_configs = make_full_facet_cover(config)
+    subgrid_configs = make_full_subgrid_cover(config)
+    sources = _bench_sources(config.image_size)
+    facet_tasks = [
+        (fc, make_facet(config.image_size, fc, sources))
+        for fc in facet_configs
+    ]
+
+    # ONE recorded stream in the parent; its exported manifest is the
+    # cross-process L2 every worker serves through the spill directory
+    # (disk-backed: export_manifest forces every entry to its atomic
+    # on-disk form for the workers to mmap)
+    spill = SpillCache(budget_bytes=spill_budget_bytes(),
+                       spill_dir=tempfile.gettempdir())
+    engine = IncrementalForward(config, facet_tasks, spill)
+    engine.record(subgrid_configs)
+
+    spec = make_worker_spec(
+        params, sources, max_depth=max_depth, max_batch=max_batch,
+    )
+
+    # fabricate the wreckage of a "crashed" previous fleet so start()'s
+    # hygiene sweep has something real to clean: a run dir owned by a
+    # dead pid holding a stale socket file and a pidfile pointing at a
+    # LIVE decoy process whose cmdline carries the worker marker — the
+    # sweep must remove the socket and SIGKILL the decoy (marker match,
+    # never pid alone)
+    run_root = os.path.join(
+        tempfile.gettempdir(), f"swiftly_procfleet_bench_{os.getpid()}")
+    stale_dir = os.path.join(run_root, "run-stale-crashed")
+    os.makedirs(stale_dir, exist_ok=True)
+    open(os.path.join(stale_dir, "worker-0.g1.sock"), "w").close()
+    with open(os.path.join(stale_dir, "fleet.pid"), "w") as fh:
+        fh.write("999999")  # long-dead owner pid
+    decoy = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)",
+         "swiftly_tpu.serve.procfleet", "--worker"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    # wait for the exec: until then /proc/<pid>/cmdline still shows THIS
+    # process's argv and the sweep would (rightly) refuse to signal it
+    from swiftly_tpu.serve.procfleet import _cmdline_matches
+
+    decoy_deadline = time.monotonic() + 10.0
+    while (not _cmdline_matches(decoy.pid)
+           and time.monotonic() < decoy_deadline):
+        time.sleep(0.01)
+    with open(os.path.join(stale_dir, "worker-0.pid"), "w") as fh:
+        fh.write(str(decoy.pid))
+
+    fleet = ProcessFleet(
+        spec, n_workers, stream_spill=spill, run_root=run_root,
+        lease_interval_s=0.02, miss_suspect=3, miss_revoke=6,
+        breaker_threshold=3, breaker_reopen_s=0.3,
+        breaker_max_reopen_s=4.0, half_open_probes=2,
+        restart_backoff_s=0.2, restart_backoff_max_s=2.0,
+        boot_deadline_s=240.0,
+    )
+
+    workload, hot_off0 = _zipf_workload(
+        subgrid_configs, per_phase, seed, zipf_s
+    )
+
+    fleet_span = otrace.span("bench.procfleet", cat="bench", config=name)
+    t0 = time.time()
+    fleet_span.__enter__()
+    tracked = []
+    try:
+        fleet.start()
+        # the decoy must be dead (it is our child: reap the zombie)
+        try:
+            decoy.wait(timeout=10.0)
+            decoy_reaped = True
+        except Exception:
+            decoy_reaped = False
+        orphans = {
+            "orphans_reaped": fleet.counts["orphans_reaped"],
+            "stale_sockets_swept": fleet.counts["stale_sockets_swept"],
+            "decoy_reaped": decoy_reaped,
+        }
+
+        def run_phase(label, drain_timeout=120.0):
+            phase = []
+            for sg in workload:
+                fr = fleet.submit(sg, priority=1)
+                phase.append((sg, fr))
+                tracked.append((sg, fr))
+            if not fleet.drain(timeout_s=drain_timeout):
+                log.error("phase %s did not drain", label)
+            oks = [
+                fr.result.latency_s
+                for _sg, fr in phase
+                if fr.result is not None and fr.result.ok
+            ]
+            return phase, oks
+
+        # -- phase 1: clean baseline window -------------------------------
+        _phase_a, lat_before = run_phase("before")
+        p99_before = _lat_quantile_ms(lat_before, 0.99)
+
+        # -- phase 2: SIGKILL -9 mid-burst --------------------------------
+        # the victim is the hot column's preferred worker, so the burst's
+        # head is queued/in-flight ON the victim when the kill lands
+        victim = max(
+            range(n_workers), key=lambda r: _rendezvous_score(hot_off0, r))
+        phase_b = []
+        burst_head = max(2, len(workload) // 3)
+        for sg in workload[:burst_head]:
+            fr = fleet.submit(sg, priority=1)
+            phase_b.append((sg, fr))
+            tracked.append((sg, fr))
+        killed_pid = fleet.kill_worker(victim, signal.SIGKILL)
+        for sg in workload[burst_head:]:
+            fr = fleet.submit(sg, priority=1)
+            phase_b.append((sg, fr))
+            tracked.append((sg, fr))
+        if not fleet.drain(timeout_s=120.0):
+            log.error("kill phase did not drain")
+        lat_during = [
+            fr.result.latency_s
+            for _sg, fr in phase_b
+            if fr.result is not None and fr.result.ok
+        ]
+        p99_during = _lat_quantile_ms(lat_during, 0.99)
+        # wait for DETECTION: the silent socket must miss enough beats
+        # for the lease to revoke (trips the breaker, stamps the death)
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            w = fleet.worker(victim)
+            if w.lease is not None and w.lease.revoked or w.dead:
+                break
+            time.sleep(0.005)
+        kill_post_mortem = (
+            orecorder.post_mortem(
+                "WorkerSIGKILLed",
+                reason=f"worker {victim} pid {killed_pid} killed -9",
+            )
+            if orecorder is not None else None
+        )
+
+        # -- phase 3: supervised restart + half-open → closed -------------
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            w = fleet.worker(victim)
+            if w.ready and not w.dead and w.generation >= 2:
+                break
+            time.sleep(0.01)
+        victim_cols = [
+            sg for sg in subgrid_configs
+            if max(range(n_workers),
+                   key=lambda r: _rendezvous_score(sg.off0, r)) == victim
+        ] or list(subgrid_configs)
+        deadline = time.time() + 20.0
+        i = 0
+        while (
+            fleet.worker(victim).breaker.state != "closed"
+            and time.time() < deadline
+        ):
+            sg = victim_cols[i % len(victim_cols)]
+            i += 1
+            fr = fleet.submit(sg, priority=1)
+            tracked.append((sg, fr))
+            fleet.drain(timeout_s=30.0)
+            time.sleep(0.02)
+        _phase_c, lat_after = run_phase("after")
+        p99_after = _lat_quantile_ms(lat_after, 0.99)
+
+        # -- phase 4: SIGKILL while the victim holds an L2 read -----------
+        fleet.drain(timeout_s=60.0)
+        fleet.wait_ready(60.0)
+        victim2 = next(
+            r for r in range(n_workers) if r != victim)
+        col2 = next(
+            sg for sg in subgrid_configs
+            if max(range(n_workers),
+                   key=lambda r: _rendezvous_score(sg.off0, r)) == victim2)
+        flag = fleet.dwell_flag_path(victim2)
+        try:
+            os.unlink(flag)
+        except OSError:
+            pass
+        fleet.set_control(victim2, dwell_l2_s=dwell_s)
+        time.sleep(0.05)  # let the worker ack the CONTROL frame
+        fr2 = fleet.submit(col2, priority=1)
+        tracked.append((col2, fr2))
+        killed_mid_read = False
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            if os.path.exists(flag):
+                # the worker is INSIDE get_row with the row mmapped
+                fleet.kill_worker(victim2, signal.SIGKILL)
+                killed_mid_read = True
+                break
+            time.sleep(0.002)
+        if not fleet.drain(timeout_s=60.0):
+            log.error("mid-L2-read kill phase did not drain")
+        res2 = fr2.result
+        row_ref = engine.feed().lookup(col2)
+        row_bit_identical = bool(
+            res2 is not None and res2.ok and row_ref is not None
+            and np.array_equal(np.asarray(res2.data), np.asarray(row_ref))
+        )
+        mid_l2_kill = {
+            "killed_mid_read": killed_mid_read,
+            "row_bit_identical": row_bit_identical,
+            "dwell_s": dwell_s,
+            "victim": victim2,
+            "served_by_path": None if res2 is None else res2.path,
+        }
+        # let victim2's restart land so stop() drains a whole fleet
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            w2 = fleet.worker(victim2)
+            if w2.ready and not w2.dead:
+                break
+            time.sleep(0.01)
+
+        fleet.drain(timeout_s=60.0)
+        wall = time.time() - t0
+        stats = fleet.stats(wall_s=wall)
+        lost = fleet.lost_requests()
+    finally:
+        try:
+            fleet.stop(drain=True)
+        except Exception:
+            log.exception("fleet stop failed")
+        if decoy.poll() is None:  # hygiene sweep failed: don't leak it
+            decoy.kill()
+            decoy.wait(timeout=5.0)
+        import shutil as _shutil
+
+        _shutil.rmtree(run_root, ignore_errors=True)
+    fleet_span.__exit__(None, None, None)
+
+    # -- bit-identity audit: every served result vs ITS path's fresh
+    # reference. Cache rows must equal the parent's own recorded stream
+    # (the workers mmap those exact bytes through the exported
+    # manifest); compute results must equal per-request
+    # get_subgrid_task on a fresh forward; the cross-program allclose
+    # guard catches wrong-row serving either way.
+    fwd_ref = SwiftlyForward(config, facet_tasks, lru_forward=2,
+                             queue_size=64)
+    stream_ref = engine.feed()
+    ref_cache = {}
+    checked = mismatches = cross_mismatches = 0
+    for sg, fr in tracked:
+        res = fr.result
+        if res is None or not res.ok:
+            continue
+        key = (sg.off0, sg.off1)
+        if key not in ref_cache:
+            srow = stream_ref.lookup(sg)
+            ref_cache[key] = (
+                np.asarray(fwd_ref.get_subgrid_task(sg)),
+                None if srow is None else np.asarray(srow),
+            )
+        compute_ref, cache_ref = ref_cache[key]
+        expected = (
+            cache_ref
+            if res.path == "cache" and cache_ref is not None
+            else compute_ref
+        )
+        got = np.asarray(res.data)
+        checked += 1
+        if not np.array_equal(got, expected):
+            mismatches += 1
+        if not np.allclose(got, compute_ref, rtol=1e-4, atol=1e-8):
+            cross_mismatches += 1
+
+    n_ok = sum(
+        1 for _sg, fr in tracked
+        if fr.result is not None and fr.result.ok
+    )
+    victim_cycle = [
+        t["to"] for t in stats["breakers"][victim]["transitions"]
+    ]
+    n_cols = len({sg.off0 for sg in subgrid_configs})
+    failover_ms = stats["failover_ms"]
+    record = {
+        "metric": (
+            f"{name} process-fleet SIGKILL drill "
+            f"({len(tracked)} zipf requests over {n_cols} columns, "
+            f"{n_workers} worker processes, kill+restart+mid-L2-read "
+            f"kill, planar f32, {platform})"
+        ),
+        "value": round(wall, 4),
+        "unit": "s",
+        "throughput_rps": (
+            round(stats["served"] / wall, 2) if wall else 0.0
+        ),
+        "p50_ms": stats["p50_ms"],
+        "p99_ms": stats["p99_ms"],
+        "n_requests": stats["requests"],
+        "n_served": stats["served"],
+        "n_shed": stats["shed"],
+        "bit_identical": {
+            "checked": checked,
+            "mismatches": mismatches,
+            "cross_program_mismatches": cross_mismatches,
+        },
+        "procfleet": {
+            "n_workers": n_workers,
+            "victim": victim,
+            "victim_pid": killed_pid,
+            "worker_deaths": stats["worker_deaths"],
+            "restarts": stats["restarts"],
+            "failovers": stats["failovers"],
+            "reroutes": stats["reroutes"],
+            "lost_requests": lost,
+            "failover_ms": failover_ms,
+            "failover_episodes": stats["failover_episodes"],
+            "p99_before_ms": p99_before,
+            "p99_during_ms": p99_during,
+            "p99_after_ms": p99_after,
+            "p99_recovery_ratio": (
+                round(p99_after / p99_before, 3) if p99_before else None
+            ),
+            "breaker_cycle": victim_cycle,
+            "breakers": {
+                str(rid): b for rid, b in stats["breakers"].items()
+            },
+            "health_transitions": stats["health"]["transitions"],
+            "per_worker": stats["per_worker"],
+            "orphans": orphans,
+            "mid_l2_kill": mid_l2_kill,
+            "wire": {
+                "heartbeats": stats["heartbeats"],
+            },
+        },
+        "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
+        "n_subgrids_cover": len(subgrid_configs),
+        "manifest": run_manifest(
+            params={"config": name, "mode": "procfleet", **params},
+        ),
+    }
+    if orecorder is not None:
+        pm_path = os.path.splitext(out_path)[0] + "_postmortem.jsonl"
+        orecorder.dump(
+            pm_path, "WorkerSIGKILLed",
+            reason=f"worker {victim} pid {killed_pid} killed -9",
+        )
+        record["post_mortem"] = dict(
+            kill_post_mortem
+            or orecorder.post_mortem("drill_complete")
+        )
+        record["post_mortem"]["dump_path"] = pm_path
+    if metrics.enabled():
+        record["telemetry"] = metrics.export()
+    if trace_path:
+        from swiftly_tpu.obs import summarize_trace
+
+        summary = summarize_trace(
+            otrace.export(), root_id=getattr(fleet_span, "id", None)
+        )
+        summary["leg_wall_s"] = round(wall, 6)
+        record["trace"] = summary
+        otrace.save(trace_path)
+        otrace.disable()
+
+    problems = validate_procfleet_artifact(record)
+    if smoke_mode:
+        # drill outcomes: schema passing is not proof the fleet survived
+        if lost != 0:
+            problems.append(f"lost requests: {lost}")
+        if n_ok != len(tracked):
+            problems.append(
+                f"{len(tracked) - n_ok} of {len(tracked)} requests "
+                "not served ok"
+            )
+        if mismatches or checked != n_ok:
+            problems.append(
+                f"bit-identity audit failed: {mismatches} mismatches, "
+                f"{checked}/{n_ok} checked"
+            )
+        if cross_mismatches:
+            problems.append(
+                f"cross-program audit failed: {cross_mismatches} "
+                "results diverge from per-request compute beyond "
+                "reduction-order noise (wrong-row serving)"
+            )
+        if stats["worker_deaths"] < 2:
+            problems.append(
+                f"expected 2 real worker deaths (mid-burst + mid-L2-"
+                f"read), got {stats['worker_deaths']}"
+            )
+        if stats["restarts"] < 1:
+            problems.append("supervisor never restarted a dead worker")
+        if stats["failovers"] < 1:
+            problems.append("the SIGKILL produced no failover")
+        for state in ("open", "half_open", "closed"):
+            if state not in victim_cycle:
+                problems.append(
+                    f"victim breaker never reached {state!r} "
+                    f"(cycle: {victim_cycle})"
+                )
+        if not any(
+            h["owner"] == victim and h["to"] == "revoked"
+            for h in stats["health"]["transitions"]
+        ):
+            problems.append("victim lease was never revoked")
+        if not killed_mid_read:
+            problems.append(
+                "the dwell flag never appeared: the second kill did "
+                "not land inside an L2 read"
+            )
+        if not row_bit_identical:
+            problems.append(
+                "the mid-L2-read kill's failed-over row is not "
+                "bit-identical to the recorded stream"
+            )
+        if orphans["orphans_reaped"] < 1 or not orphans["decoy_reaped"]:
+            problems.append(
+                f"startup hygiene did not reap the decoy orphan: "
+                f"{orphans}"
+            )
+        if orphans["stale_sockets_swept"] < 1:
+            problems.append(
+                "startup hygiene did not sweep the stale socket"
+            )
+        if stats["heartbeats"] < 10:
+            problems.append(
+                f"suspiciously few heartbeats on the wire: "
+                f"{stats['heartbeats']}"
+            )
+        if p99_before and p99_after > 3.0 * p99_before:
+            problems.append(
+                f"p99 did not recover: {p99_after}ms after vs "
+                f"{p99_before}ms before (> 3x)"
+            )
+    with open(out_path, "w") as fh:
+        json.dump(record, fh, indent=2)
+    if smoke_mode:
+        metrics.disable()
+        print(
+            json.dumps(
+                {
+                    "procfleet_smoke": "ok" if not problems else "failed",
+                    "config": name,
+                    "artifact": out_path,
+                    "n_served": stats["served"],
+                    "lost_requests": lost,
+                    "victim": victim,
+                    "failover_ms": failover_ms,
+                    "worker_deaths": stats["worker_deaths"],
+                    "restarts": stats["restarts"],
+                    "breaker_cycle": victim_cycle,
+                    "killed_mid_read": killed_mid_read,
+                    "row_bit_identical": row_bit_identical,
+                    "orphans_reaped": orphans["orphans_reaped"],
+                    "stale_sockets_swept": orphans["stale_sockets_swept"],
+                    "heartbeats": stats["heartbeats"],
+                    "problems": problems,
+                }
+            ),
+            flush=True,
+        )
+        return 0 if not problems else 1
+    print(json.dumps(record), flush=True)
+    return 0 if not problems else 1
+
+
 def _ensure_mesh_devices(n):
     """>= 2 devices for the mesh leg: build a virtual CPU mesh when the
     process has none (`__graft_entry__._ensure_devices`, which refuses
@@ -5037,6 +5598,8 @@ def main():
         sys.exit(vis_bench(smoke_mode="--smoke" in sys.argv))
     if "--serve" in sys.argv:
         sys.exit(serve_bench(smoke_mode="--smoke" in sys.argv))
+    if "--procfleet" in sys.argv:
+        sys.exit(procfleet_bench(smoke_mode="--smoke" in sys.argv))
     if "--fleet" in sys.argv:
         sys.exit(fleet_bench(smoke_mode="--smoke" in sys.argv))
     if "--mesh" in sys.argv and "--chaos" in sys.argv:

@@ -241,6 +241,21 @@ SENTINELS = [
         "source_pr": 18,
         "applies_to": "visibility-serving (--vis) legs",
     },
+    {
+        "name": "procfleet.failover_ms",
+        "direction": "lower",
+        "threshold": "--threshold (default 20%) over best reference",
+        "source_pr": 19,
+        "applies_to": "process-fleet (--procfleet) SIGKILL drill legs",
+    },
+    {
+        "name": "procfleet.lost_requests",
+        "direction": "lower",
+        "threshold": "ANY increase over best reference (healthy is "
+                     "exactly 0)",
+        "source_pr": 19,
+        "applies_to": "process-fleet (--procfleet) SIGKILL drill legs",
+    },
 ]
 
 # metric strings look like
@@ -308,6 +323,7 @@ def compare(latest_records, reference_records, threshold=0.2):
             {"wall": None, "mfu": None, "p99": None, "rps": None,
              "se": None, "dse": None, "rms": None, "ro": None,
              "chr": None, "sc": None, "vp99": None, "vks": None,
+             "pfo": None, "plr": None,
              "n": 0},
         )
         bucket["n"] += 1
@@ -362,6 +378,16 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(vks, (int, float)) and vks > 0:
             if bucket["vks"] is None or vks > bucket["vks"]:
                 bucket["vks"] = vks
+        pfo = (rec.get("procfleet") or {}).get("failover_ms")
+        if isinstance(pfo, (int, float)) and pfo > 0:
+            if bucket["pfo"] is None or pfo < bucket["pfo"]:
+                bucket["pfo"] = pfo
+        # lost_requests: 0 is the healthy value, so the usual "> 0"
+        # presence guard would drop exactly the references that matter
+        plr = (rec.get("procfleet") or {}).get("lost_requests")
+        if isinstance(plr, int) and not isinstance(plr, bool) and plr >= 0:
+            if bucket["plr"] is None or plr < bucket["plr"]:
+                bucket["plr"] = plr
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -569,6 +595,34 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"vis throughput {vks:.4g} ksamples/s is "
                     f"{100 * (1 - vks / ref['vks']):.1f}% below best "
                     f"reference {ref['vks']:.4g} ksamples/s"
+                )
+        # process-fleet SIGKILL drill legs: failover latency (lower is
+        # better) + lost requests (ANY increase over the reference
+        # regresses the zero-loss claim — the healthy value is 0, so
+        # presence is keyed on the block, not on a nonzero value)
+        pfo = (rec.get("procfleet") or {}).get("failover_ms")
+        if isinstance(pfo, (int, float)) and pfo > 0:
+            verdict["procfleet_failover_ms"] = pfo
+            verdict["ref_procfleet_failover_ms"] = ref["pfo"]
+            if (
+                ref["pfo"] is not None
+                and pfo > ref["pfo"] * (1.0 + threshold)
+            ):
+                verdict["problems"].append(
+                    f"procfleet failover {pfo:.4g}ms is "
+                    f"{100 * (pfo / ref['pfo'] - 1):.1f}% above best "
+                    f"reference {ref['pfo']:.4g}ms "
+                    f"(threshold {100 * threshold:.0f}%)"
+                )
+        plr = (rec.get("procfleet") or {}).get("lost_requests")
+        if isinstance(plr, int) and not isinstance(plr, bool) and plr >= 0:
+            verdict["procfleet_lost_requests"] = plr
+            verdict["ref_procfleet_lost_requests"] = ref["plr"]
+            if ref["plr"] is not None and plr > ref["plr"]:
+                verdict["problems"].append(
+                    f"{plr} lost request(s) vs {ref['plr']} in the "
+                    "best reference — the process fleet's zero-loss "
+                    "failover claim regressed"
                 )
         # precision legs: accuracy sentinel (lower is better)
         rms = rec.get("rms_vs_dft_oracle")

@@ -12,15 +12,24 @@ stamps the schema-validated ``fleet`` block into a BENCH-style
 artifact, and exits nonzero unless every request completed, results
 stayed bit-identical, the breaker cycled, and p99 recovered.
 
+With ``--procs N`` the drill runs the PROCESS fleet instead
+(`bench.procfleet_bench`): N workers as real OS processes behind
+`serve.ProcessFleet`, a real mid-burst ``SIGKILL -9`` with zero-loss
+failover, supervised restart through the breaker's half-open path, and
+a second kill landed while the victim holds an L2 read (see
+docs/resilience.md "SIGKILL drill").
+
 Usage:
     python scripts/fleet_drill.py                        # 1k, 3 replicas
     python scripts/fleet_drill.py --replicas 4 --requests 120
     python scripts/fleet_drill.py --swift_config 4k[1]-n2k-512
+    python scripts/fleet_drill.py --procs 3              # process fleet
 
 The artifact's ``fleet`` block records per-replica QPS, failover /
 hedge / brownout counters, the victim's breaker transitions and the
 p99 before/during/after windows — `scripts/bench_compare.py` sentinels
-the p99/QPS numbers against prior fleet artifacts.
+the p99/QPS numbers against prior fleet artifacts (and, for process
+drills, ``procfleet.failover_ms`` / ``procfleet.lost_requests``).
 """
 
 import argparse
@@ -45,9 +54,14 @@ def main():
                     help="fleet size (default 3)")
     ap.add_argument("--requests", type=int, default=72,
                     help="zipf requests per drill phase (default 72)")
+    ap.add_argument("--procs", type=int, default=None, metavar="N",
+                    help="run the PROCESS fleet drill instead: N worker "
+                    "processes, real SIGKILL -9 failover + mid-L2-read "
+                    "kill (bench.procfleet_bench)")
     ap.add_argument("--seed", type=int, default=1234)
-    ap.add_argument("--out", default="BENCH_fleet.json",
-                    help="artifact path (default BENCH_fleet.json)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default BENCH_fleet.json, or "
+                    "BENCH_procfleet.json with --procs)")
     ap.add_argument("--smoke", action="store_true",
                     help="assert the drill outcomes (nonzero exit on "
                     "any unhealed failure), not just the schema")
@@ -59,7 +73,34 @@ def main():
         format="%(asctime)s %(name)s: %(message)s",
         stream=sys.stderr,
     )
-    os.environ["BENCH_FLEET_OUT"] = args.out
+    log = logging.getLogger("fleet-drill")
+    if args.procs is not None:
+        out = args.out or "BENCH_procfleet.json"
+        os.environ["BENCH_PROCFLEET_OUT"] = out
+        os.environ["BENCH_PROCFLEET_CONFIG"] = args.swift_config
+        os.environ["BENCH_PROCFLEET_WORKERS"] = str(args.procs)
+        os.environ["BENCH_PROCFLEET_PHASE_REQUESTS"] = str(args.requests)
+        os.environ["BENCH_PROCFLEET_SEED"] = str(args.seed)
+
+        import bench
+
+        rc = bench.procfleet_bench(smoke_mode=args.smoke)
+        if rc == 0:
+            with open(out) as fh:
+                pf = json.load(fh)["procfleet"]
+            log.info(
+                "process fleet healed: worker %s SIGKILLed+restarted, "
+                "%d failover(s) in %.1fms, breaker %s, lost=%d, "
+                "mid-L2-read kill served bit-identical=%s",
+                pf["victim"], pf["failovers"], pf["failover_ms"],
+                "->".join(pf["breaker_cycle"]) or "n/a",
+                pf["lost_requests"],
+                pf["mid_l2_kill"]["row_bit_identical"],
+            )
+        return rc
+
+    out = args.out or "BENCH_fleet.json"
+    os.environ["BENCH_FLEET_OUT"] = out
     os.environ["BENCH_FLEET_CONFIG"] = args.swift_config
     os.environ["BENCH_FLEET_REPLICAS"] = str(args.replicas)
     os.environ["BENCH_FLEET_PHASE_REQUESTS"] = str(args.requests)
@@ -71,8 +112,7 @@ def main():
     # validation and the summary line; the CLI just parameterises it
     rc = bench.fleet_bench(smoke_mode=args.smoke)
     if rc == 0:
-        log = logging.getLogger("fleet-drill")
-        with open(args.out) as fh:
+        with open(out) as fh:
             fl = json.load(fh)["fleet"]
         log.info(
             "fleet healed: replica %s killed+restored, %d failover(s), "

@@ -57,6 +57,7 @@ from .manifest import (
     validate_fleet_artifact,
     validate_mesh_artifact,
     validate_plan_artifact,
+    validate_procfleet_artifact,
     validate_resilience_artifact,
     validate_serve_artifact,
     validate_vis_artifact,
@@ -90,6 +91,7 @@ __all__ = [
     "validate_mesh_artifact",
     "validate_plan_accuracy_artifact",
     "validate_plan_artifact",
+    "validate_procfleet_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
     "validate_trace_artifact",

@@ -33,6 +33,7 @@ __all__ = [
     "MANIFEST_SCHEMA",
     "MESH_ARTIFACT_FIELDS",
     "PLAN_ARTIFACT_FIELDS",
+    "PROCFLEET_ARTIFACT_FIELDS",
     "RESILIENCE_ARTIFACT_FIELDS",
     "SERVE_ARTIFACT_FIELDS",
     "config_hash",
@@ -42,6 +43,7 @@ __all__ = [
     "validate_fleet_artifact",
     "validate_mesh_artifact",
     "validate_plan_artifact",
+    "validate_procfleet_artifact",
     "validate_resilience_artifact",
     "validate_serve_artifact",
 ]
@@ -597,6 +599,154 @@ def _validate_cache_block(record, fleet):
                     "per_view rows need {replica, l1_hits, l2_hits}"
                 )
                 break
+    return problems
+
+
+PROCFLEET_ARTIFACT_FIELDS = (
+    "p50_ms",
+    "p99_ms",
+    "throughput_rps",
+    "n_requests",
+    "n_served",
+)
+
+_PROCFLEET_BLOCK_FIELDS = (
+    "n_workers",
+    "worker_deaths",
+    "restarts",
+    "failovers",
+    "lost_requests",
+    "failover_ms",
+    "breaker_cycle",
+    "per_worker",
+    "health_transitions",
+    "orphans",
+    "mid_l2_kill",
+    "wire",
+)
+
+
+def validate_procfleet_artifact(record):
+    """Problems with a process-fleet BENCH artifact (``--procfleet``),
+    as a list of strings.
+
+    The process drill's contract is the thread fleet's, survived for
+    real: at least one worker ``SIGKILL``ed and restarted, its breaker
+    showing the full open → half-open → closed cycle, ``lost_requests``
+    exactly 0, ``failover_ms`` a real measurement, a clean bit-identity
+    audit, a ``mid_l2_kill`` phase that landed its kill inside an L2
+    read and still served the row bit-identically, and a ``wire`` block
+    whose heartbeats actually flowed (a drill whose leases never beat
+    proved nothing).
+    """
+    problems = validate_artifact(record, require_baseline=False)
+    for field in PROCFLEET_ARTIFACT_FIELDS:
+        if field not in record:
+            problems.append(f"missing procfleet field {field!r}")
+    p50, p99 = record.get("p50_ms"), record.get("p99_ms")
+    if (
+        isinstance(p50, (int, float))
+        and isinstance(p99, (int, float))
+        and p99 < p50
+    ):
+        problems.append(f"p99_ms {p99} < p50_ms {p50}")
+    bit = record.get("bit_identical")
+    if not isinstance(bit, dict) or not (
+        {"checked", "mismatches"} <= set(bit)
+    ):
+        problems.append(
+            "missing bit_identical {checked, mismatches} block"
+        )
+    elif bit["mismatches"]:
+        problems.append(
+            f"bit-identity audit failed: {bit['mismatches']} "
+            f"mismatch(es) in {bit['checked']} checked"
+        )
+    pf = record.get("procfleet")
+    if not isinstance(pf, dict):
+        problems.append("missing procfleet block")
+        return problems
+    for field in _PROCFLEET_BLOCK_FIELDS:
+        if field not in pf:
+            problems.append(f"procfleet block missing {field!r}")
+    n = pf.get("n_workers")
+    if isinstance(n, int) and n < 2:
+        problems.append(
+            f"n_workers {n} < 2 (a one-worker fleet cannot fail over)"
+        )
+    if isinstance(pf.get("worker_deaths"), int) and pf["worker_deaths"] < 1:
+        problems.append("procfleet drill killed no worker")
+    if isinstance(pf.get("restarts"), int) and pf["restarts"] < 1:
+        problems.append("procfleet drill restarted no worker")
+    if pf.get("lost_requests") != 0:
+        problems.append(
+            f"lost_requests is {pf.get('lost_requests')!r}: the drill "
+            "must complete every admitted request"
+        )
+    fo = pf.get("failover_ms")
+    if not isinstance(fo, (int, float)) or fo < 0:
+        problems.append(
+            f"failover_ms {fo!r} is not a measured failover latency"
+        )
+    cycle = pf.get("breaker_cycle")
+    if isinstance(cycle, list):
+        missing = {"open", "half_open", "closed"} - set(cycle)
+        if missing:
+            problems.append(
+                f"breaker cycle {cycle} missing state(s) "
+                f"{sorted(missing)} — the victim's breaker must open, "
+                "half-open and close in the artifact"
+            )
+    per = pf.get("per_worker")
+    if isinstance(per, list):
+        if isinstance(n, int) and len(per) != n:
+            problems.append(
+                f"per_worker has {len(per)} row(s) for {n} workers"
+            )
+        for row in per:
+            if not isinstance(row, dict) or not (
+                {"id", "served", "qps"} <= set(row)
+            ):
+                problems.append("per_worker rows need {id, served, qps}")
+                break
+    orphans = pf.get("orphans")
+    if orphans is not None:
+        if not isinstance(orphans, dict) or not (
+            {"orphans_reaped", "stale_sockets_swept"} <= set(orphans)
+        ):
+            problems.append(
+                "orphans block needs {orphans_reaped, stale_sockets_swept}"
+            )
+    l2 = pf.get("mid_l2_kill")
+    if not isinstance(l2, dict) or not (
+        {"killed_mid_read", "row_bit_identical"} <= set(l2)
+    ):
+        problems.append(
+            "missing mid_l2_kill {killed_mid_read, row_bit_identical} "
+            "block"
+        )
+    else:
+        if l2.get("killed_mid_read") is not True:
+            problems.append(
+                "mid_l2_kill phase never landed its kill inside an L2 "
+                "read"
+            )
+        if l2.get("row_bit_identical") is not True:
+            problems.append(
+                "mid_l2_kill phase observed a torn or stale row "
+                "cross-process"
+            )
+    wire = pf.get("wire")
+    if wire is not None:
+        if not isinstance(wire, dict) or not isinstance(
+            wire.get("heartbeats"), int
+        ):
+            problems.append("wire block needs a heartbeats count")
+        elif wire["heartbeats"] < 1:
+            problems.append(
+                "wire block shows no heartbeats — leases never beat "
+                "on the wire"
+            )
     return problems
 
 

@@ -21,6 +21,7 @@ COLLECTIVES = ("psum", "ring")
 __all__ = [
     "COLLECTIVES",
     "FACET_AXIS",
+    "bootstrap_from_env",
     "facet_sharding",
     "mesh_size",
     "initialize_multihost",
@@ -166,3 +167,41 @@ def initialize_multihost(coordinator=None, num_processes=None, process_id=None):
     if process_id is not None:
         kwargs["process_id"] = process_id
     jax.distributed.initialize(**kwargs)
+
+
+def bootstrap_from_env():
+    """Env-driven `jax.distributed` bootstrap — the process-spanning
+    mesh's entry point (docs/multichip.md "Multi-process bootstrap").
+
+    Reads ``SWIFTLY_COORDINATOR`` (host:port of process 0's
+    coordinator), ``SWIFTLY_NUM_PROCESSES`` and ``SWIFTLY_PROCESS_ID``
+    and calls `initialize_multihost` with whatever is set. With NONE of
+    them set this is a no-op returning ``None`` — single-process runs
+    (and TPU pods whose orchestrator auto-discovers all three) need no
+    environment at all. Returns the resolved
+    ``{coordinator, num_processes, process_id}`` dict when a bootstrap
+    happened, so callers can log what they joined.
+
+    Must run before any device use in the process;
+    ``__graft_entry__.dryrun_distributed`` drives a real 2-process
+    CPU bootstrap through exactly this path.
+    """
+    coordinator = os.environ.get("SWIFTLY_COORDINATOR") or None
+    num_processes = os.environ.get("SWIFTLY_NUM_PROCESSES") or None
+    process_id = os.environ.get("SWIFTLY_PROCESS_ID") or None
+    if coordinator is None and num_processes is None and process_id is None:
+        return None
+    if num_processes is not None:
+        num_processes = int(num_processes)
+    if process_id is not None:
+        process_id = int(process_id)
+    initialize_multihost(
+        coordinator=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return {
+        "coordinator": coordinator,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }

@@ -27,6 +27,13 @@ arXiv:2002.03260 applied to ragged demand):
   and hedged sends — the self-healing serve fleet ``bench.py --fleet``
   drills. Attach a `cache.SharedStreamTier` and the replicas serve
   per-replica L1 views over ONE resident recorded stream;
+* `serve.procfleet.ProcessFleet` — the same serving contract across
+  REAL process boundaries: each replica a separate OS process behind
+  a front-door router, speaking `serve.ipc`'s versioned
+  length-prefixed wire frames; heartbeats on the wire, cross-process
+  L2 through the shared spill directory, and a supervisor that reaps
+  and restarts killed workers (``bench.py --procfleet`` lands a real
+  ``SIGKILL -9`` mid-burst and proves zero loss);
 * `serve.autoscale.FleetAutoscaler` — queue-share-driven elastic
   replica count over a ``[min, max]`` band with hysteresis: scale out
   via `ServeFleet.add_replica` (a fabric view, not a stream copy) and
@@ -57,6 +64,7 @@ from .queue import (
     RequestResult,
     SubgridRequest,
 )
+from .procfleet import ProcessFleet, SharedSpillReader, make_worker_spec
 from .scheduler import CoalescingScheduler
 from .service import (
     SubgridService,
@@ -72,10 +80,12 @@ __all__ = [
     "HealthLease",
     "HealthMonitor",
     "LIVE",
+    "ProcessFleet",
     "Replica",
     "RequestResult",
     "REVOKED",
     "ServeFleet",
+    "SharedSpillReader",
     "SubgridRequest",
     "SubgridService",
     "SUSPECT",
@@ -83,6 +93,7 @@ __all__ = [
     "STATUS_OK",
     "STATUS_QUARANTINED",
     "STATUS_SHED",
+    "make_worker_spec",
     "projected_column_bytes",
     "projected_request_bytes",
 ]

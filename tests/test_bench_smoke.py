@@ -163,6 +163,10 @@ def test_bench_smoke_leg(tmp_path):
     ) == 1
 
 
+# Rides -m slow per the tier-1 budget: test_bench_procfleet_smoke_leg keeps
+# the serve stack (ledger, failover, breaker, L2) under a bench leg in
+# tier-1, and the serve sentinels stay tier-1 synthetically below.
+@pytest.mark.slow
 def test_bench_serve_smoke_leg(tmp_path):
     """The `bench.py --serve --smoke` leg: a zipf-over-columns workload
     served through the coalescing scheduler on CPU, with the latency-SLO
@@ -382,6 +386,189 @@ def test_bench_fleet_smoke_leg(tmp_path):
     ) == 1
 
 
+def test_bench_procfleet_smoke_leg(tmp_path):
+    """The `bench.py --procfleet --smoke` drill end-to-end in a fresh
+    subprocess: 2 real worker PROCESSES behind `serve.ProcessFleet`
+    (versioned wire frames over unix sockets, lease heartbeats on the
+    wire), a fabricated stale run swept at startup (orphan worker
+    reaped by pid + cmdline marker, stale socket unlinked), a mid-burst
+    ``SIGKILL -9`` with zero-loss failover, supervised restart through
+    the breaker's open → half-open → closed cycle, and a second kill
+    landed while the victim holds a shared-L2 mmap read — every result
+    audited bit-identical against an in-process reference engine. The
+    2-worker smoke keeps this in tier-1; the wire protocol and hygiene
+    units live in tests/test_ipc.py + tests/test_procfleet.py."""
+    out = tmp_path / "BENCH_procfleet.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_PROCFLEET_OUT=str(out),
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--procfleet",
+         "--smoke"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["procfleet_smoke"] == "ok", summary
+    assert summary["problems"] == []
+    assert summary["lost_requests"] == 0
+    assert summary["killed_mid_read"] is True
+    assert summary["row_bit_identical"] is True
+
+    # re-validate the artifact out-of-process (the drill's own pass is
+    # not proof the promised fields landed on disk)
+    from swiftly_tpu.obs import validate_procfleet_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_procfleet_artifact(record) == []
+    pf = record["procfleet"]
+    assert pf["lost_requests"] == 0
+    assert record["bit_identical"]["mismatches"] == 0
+    assert record["bit_identical"]["checked"] == record["n_served"]
+    assert record["bit_identical"]["cross_program_mismatches"] == 0
+    # two real SIGKILLs (mid-burst + mid-L2-read), both restarted
+    assert pf["worker_deaths"] >= 2 and pf["restarts"] >= 2
+    assert pf["failovers"] >= 1
+    assert isinstance(pf["failover_ms"], float) and pf["failover_ms"] > 0
+    # the victim's breaker cycled, in order
+    cyc = pf["breaker_cycle"]
+    i_open = cyc.index("open")
+    i_half = cyc.index("half_open", i_open)
+    assert "closed" in cyc[i_half:]
+    # the victim's lease was revoked on the silent socket
+    victim = pf["victim"]
+    assert any(
+        h["owner"] == victim and h["to"] == "revoked"
+        for h in pf["health_transitions"]
+    )
+    # startup hygiene found the fabricated wreckage
+    assert pf["orphans"]["orphans_reaped"] >= 1
+    assert pf["orphans"]["stale_sockets_swept"] >= 1
+    # leases beat on the wire; the mid-L2-read kill proved no torn row
+    assert pf["wire"]["heartbeats"] >= 10
+    assert pf["mid_l2_kill"]["killed_mid_read"] is True
+    assert pf["mid_l2_kill"]["row_bit_identical"] is True
+    assert len(pf["per_worker"]) == pf["n_workers"] == 2
+    assert record["manifest"]["device"]["platform"] == "cpu"
+
+    # --- the procfleet sentinels (in-process: no extra spawn) ---------
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    ref = tmp_path / "BENCH_procfleet_ref.json"
+    ref.write_text(json.dumps(record))
+    # identical artifact -> green
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 0
+    # doctored 2x-faster failover in the reference -> trip
+    doctored = json.loads(out.read_text())
+    doctored["procfleet"]["failover_ms"] = pf["failover_ms"] / 2.0
+    ref.write_text(json.dumps(doctored))
+    assert compare_main(
+        [str(out), "--against", str(ref), "--json"]
+    ) == 1
+    # a latest run that LOST requests must trip against the clean
+    # zero-loss reference (no threshold: ANY loss breaks the contract)
+    ref.write_text(json.dumps(record))
+    worse = tmp_path / "BENCH_procfleet_lost.json"
+    regressed = json.loads(out.read_text())
+    regressed["procfleet"]["lost_requests"] = 2
+    worse.write_text(json.dumps(regressed))
+    assert compare_main(
+        [str(worse), "--against", str(ref), "--json"]
+    ) == 1
+
+
+@pytest.mark.slow
+def test_bench_procfleet_full_drill(tmp_path):
+    """The full-size process drill (3 workers, 48 requests per phase,
+    smoke assertions ON): the tier-1 leg above runs the cheap 2-worker
+    shape; this proves the drill holds with a survivor majority."""
+    out = tmp_path / "BENCH_procfleet_full.json"
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_PROCFLEET_OUT=str(out),
+        BENCH_PROCFLEET_WORKERS="3",
+        BENCH_PROCFLEET_PHASE_REQUESTS="48",
+        BENCH_PARTIAL_PATH="",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "fleet_drill.py"),
+         "--procs", "3", "--smoke", "--out", str(out)],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=540,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    from swiftly_tpu.obs import validate_procfleet_artifact
+
+    record = json.loads(out.read_text())
+    assert validate_procfleet_artifact(record) == []
+    pf = record["procfleet"]
+    assert pf["n_workers"] == 3
+    assert pf["lost_requests"] == 0
+    assert record["bit_identical"]["mismatches"] == 0
+
+
+def test_compare_procfleet_sentinels_synthetic(tmp_path):
+    """The `procfleet.failover_ms` / `procfleet.lost_requests`
+    sentinels in scripts/bench_compare.py, exercised in tier-1 on
+    synthetic records (the drill that stamps real ones spawns worker
+    processes): identical records stay green, failover latency trips at
+    the 20% threshold over the best reference, and ANY lost request
+    over a zero-loss reference trips with no threshold arithmetic —
+    the healthy reference value is exactly 0, which the extraction must
+    keep (a `> 0` presence guard would drop every reference that
+    matters)."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    def rec(failover_ms=14.0, lost_requests=0):
+        return {
+            "metric": "procfleet drill wall-clock",
+            "value": 4.0,
+            "manifest": {
+                "config_params": {
+                    "config": "1k[1]-n512-256", "mode": "procfleet",
+                },
+                "device": {"platform": "cpu"},
+            },
+            "p99_ms": 80.0,
+            "throughput_rps": 12.0,
+            "procfleet": {
+                "failover_ms": failover_ms,
+                "lost_requests": lost_requests,
+            },
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    latest.write_text(json.dumps(rec()))
+    ref.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # failover latency regressed >20% over the best reference -> trip
+    latest.write_text(json.dumps(rec(failover_ms=28.0)))
+    assert compare_main(args) == 1
+    # within the threshold -> green (it is a threshold, not equality)
+    latest.write_text(json.dumps(rec(failover_ms=16.0)))
+    assert compare_main(args) == 0
+    # lost requests: ANY increase over the zero-loss reference trips
+    latest.write_text(json.dumps(rec(lost_requests=1)))
+    assert compare_main(args) == 1
+    # ...equal (still zero) stays green, and an improving run against a
+    # lossy reference stays green too
+    latest.write_text(json.dumps(rec(lost_requests=0)))
+    assert compare_main(args) == 0
+    ref.write_text(json.dumps(rec(lost_requests=3)))
+    assert compare_main(args) == 0
+
+
 def test_compare_fabric_sentinels_synthetic(tmp_path):
     """The `cache.hit_ratio` / `fleet.stream_copies` sentinels in
     scripts/bench_compare.py, exercised in tier-1 on synthetic records
@@ -527,6 +714,10 @@ def test_compare_collective_pedigree_sentinel_synthetic(tmp_path):
     assert leg["collective"] == "psum"
 
 
+# Rides -m slow per the tier-1 budget: test_bench_mesh_chaos_smoke_leg
+# keeps a mesh bench leg in tier-1, and the fabric/collective sentinels
+# stay tier-1 via the synthetic compare tests above.
+@pytest.mark.slow
 def test_bench_mesh_smoke_leg(tmp_path):
     """The `bench.py --mesh --smoke` leg (ISSUE-8 acceptance), run
     exactly as the driver would — fresh subprocess, CPU with 8 virtual

@@ -603,3 +603,78 @@ def test_mesh_engine_1k_drill(collective, monkeypatch):
     got = mbwd.finish()
     scale = float(np.max(np.abs(ref)))
     assert float(np.max(np.abs(got - ref))) <= 5e-5 * scale
+
+
+# ---------------------------------------------------------------------------
+# env-driven multi-process bootstrap (docs/multichip.md)
+# ---------------------------------------------------------------------------
+
+
+def test_bootstrap_from_env_noop_without_env(monkeypatch):
+    """With NONE of the SWIFTLY_* knobs set, `bootstrap_from_env` is a
+    no-op returning None — single-process runs (and auto-discovering
+    pod orchestrators) must never touch jax.distributed."""
+    import jax
+
+    from swiftly_tpu.parallel.mesh import bootstrap_from_env
+
+    for k in ("SWIFTLY_COORDINATOR", "SWIFTLY_NUM_PROCESSES",
+              "SWIFTLY_PROCESS_ID"):
+        monkeypatch.delenv(k, raising=False)
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw))
+    assert bootstrap_from_env() is None
+    assert calls == []
+
+
+def test_bootstrap_from_env_passes_knobs(monkeypatch):
+    """The three env knobs reach jax.distributed.initialize under their
+    JAX names, coerced to ints, and come back in the resolved dict."""
+    import jax
+
+    from swiftly_tpu.parallel.mesh import bootstrap_from_env
+
+    monkeypatch.setenv("SWIFTLY_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("SWIFTLY_NUM_PROCESSES", "4")
+    monkeypatch.setenv("SWIFTLY_PROCESS_ID", "2")
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: calls.append(kw))
+    resolved = bootstrap_from_env()
+    assert calls == [{
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }]
+    assert resolved == {
+        "coordinator": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+    # partial env (pod auto-discovery fills the rest): only the set
+    # knobs are forwarded
+    monkeypatch.delenv("SWIFTLY_COORDINATOR")
+    monkeypatch.delenv("SWIFTLY_PROCESS_ID")
+    calls.clear()
+    assert bootstrap_from_env() == {
+        "coordinator": None, "num_processes": 4, "process_id": None}
+    assert calls == [{"num_processes": 4}]
+
+
+@pytest.mark.slow
+def test_dryrun_distributed_two_process_bootstrap():
+    """A REAL 2-process jax.distributed CPU bootstrap through
+    `bootstrap_from_env` (`__graft_entry__.dryrun_distributed`): both
+    children join the coordinator, agree on process_count, and verify
+    the mesh guide's env contract end-to-end."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(
+        __file__).resolve().parents[1]))
+    from __graft_entry__ import dryrun_distributed
+
+    # raises RuntimeError with per-child logs on any failed join
+    dryrun_distributed(n_procs=2)

@@ -1,0 +1,342 @@
+"""Process-fleet unit tests (`serve.procfleet`) — the pieces that do
+NOT need a booted fleet, pinned fast and in-process:
+
+* SHARED L2 — `SharedSpillReader` re-reads the fleet's stream-state
+  file on every gate property, so the parent flipping ``complete`` /
+  ``patching`` / ``stream_version`` is visible to worker feeds with no
+  extra protocol; a missing or torn state file REFUSES (incomplete +
+  patching + version -1), it never serves under an unknown stream;
+* ATOMIC STATE — `write_stream_state` publishes via tmp-sibling +
+  rename: readers see the old state or the new one, never a torn file,
+  and no tmp droppings survive;
+* DWELL — the drill knob holds the mapped read open and announces
+  itself through the flag file (the SIGKILL window the bench uses);
+* HYGIENE — `_sweep_stale_runs` reaps a marker-verified orphaned
+  worker from a dead fleet's run dir, sweeps its stale socket, bumps
+  the ``proc.orphans_reaped`` counters — and leaves a LIVE fleet's run
+  dir strictly alone;
+* SPEC — `make_worker_spec` is a plain picklable dict with coerced
+  scalar types;
+* SCHEMA — `obs.validate_procfleet_artifact` passes the healthy drill
+  shape and trips on every contract break (lost requests, missing
+  mid-L2-kill proof, an unfinished breaker cycle, ...).
+
+The real multi-process SIGKILL drill lives in test_bench_smoke.py.
+"""
+
+import json
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from swiftly_tpu.obs import validate_procfleet_artifact
+from swiftly_tpu.serve import procfleet
+from swiftly_tpu.serve.procfleet import (
+    ProcessFleet,
+    SharedSpillReader,
+    make_worker_spec,
+    write_stream_state,
+)
+
+DEAD_PID = 2 ** 22 + 12345  # far above any default pid_max allocation
+
+
+# ---------------------------------------------------------------------------
+# shared L2 reader gates
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def manifest(tmp_path):
+    rows = np.arange(32, dtype=np.complex64).reshape(4, 8)
+    entry = tmp_path / "entry-0.npy"
+    np.save(entry, rows)
+    return {
+        "entries": [str(entry)],
+        "meta": [{"shape": (4, 8)}],
+        "stream_version": 3,
+    }
+
+
+def test_reader_gates_track_state_file(manifest, tmp_path):
+    state = tmp_path / "stream_state.json"
+    reader = SharedSpillReader(manifest, str(state))
+
+    # no state file yet: refuse (the feed recomputes, never serves)
+    assert reader.complete is False
+    assert reader.patching is True
+    assert reader.stream_version == -1
+
+    write_stream_state(str(state), stream_version=3)
+    assert reader.complete is True
+    assert reader.patching is False
+    assert reader.stream_version == 3
+
+    # the parent starts a patch: the SAME reader object sees it flip
+    write_stream_state(str(state), stream_version=3, patching=True)
+    assert reader.patching is True
+
+    # a new stream version invalidates without any worker-side action
+    write_stream_state(str(state), stream_version=4)
+    assert reader.stream_version == 4
+
+
+def test_reader_refuses_torn_state_file(manifest, tmp_path):
+    state = tmp_path / "stream_state.json"
+    state.write_text('{"stream_version": 3, "comp')  # torn mid-write
+    reader = SharedSpillReader(manifest, str(state))
+    assert reader.complete is False
+    assert reader.patching is True
+    assert reader.stream_version == -1
+
+
+def test_reader_get_row_bit_identical(manifest, tmp_path):
+    state = tmp_path / "stream_state.json"
+    write_stream_state(str(state), stream_version=3)
+    reader = SharedSpillReader(manifest, str(state))
+    assert len(reader) == 1
+    assert reader.meta(0) == {"shape": (4, 8)}
+    expect = np.arange(32, dtype=np.complex64).reshape(4, 8)[2]
+    got = reader.get_row(0, 2)
+    assert np.array_equal(got, expect)
+    assert reader.rows_read == 1
+
+
+def test_reader_dwell_writes_flag(manifest, tmp_path):
+    state = tmp_path / "stream_state.json"
+    write_stream_state(str(state), stream_version=3)
+    flag = tmp_path / "dwell.flag"
+    reader = SharedSpillReader(manifest, str(state),
+                               dwell_flag_path=str(flag))
+    reader.dwell_s = 0.05
+    t0 = time.monotonic()
+    reader.get_row(0, 1)
+    assert time.monotonic() - t0 >= 0.05
+    assert flag.read_text() == str(os.getpid())
+
+
+def test_write_stream_state_atomic(tmp_path):
+    state = tmp_path / "stream_state.json"
+    write_stream_state(str(state), stream_version=7, complete=False,
+                       patching=True)
+    assert json.loads(state.read_text()) == {
+        "stream_version": 7, "complete": False, "patching": True}
+    # no tmp sibling survives the rename
+    assert os.listdir(tmp_path) == ["stream_state.json"]
+
+
+# ---------------------------------------------------------------------------
+# worker spec
+# ---------------------------------------------------------------------------
+
+
+def test_make_worker_spec_picklable_and_typed():
+    spec = make_worker_spec(
+        {"N": 512, "yB_size": 256}, [(1.0, 3, 4)],
+        max_depth="128", max_batch=8.0, lease_interval_s="0.05")
+    assert spec["params"] == {"N": 512, "yB_size": 256}
+    assert spec["sources"] == [(1.0, 3, 4)]
+    assert spec["max_depth"] == 128
+    assert spec["max_batch"] == 8
+    assert spec["lease_interval_s"] == 0.05
+    assert spec["stream"] is None
+    # crosses the process boundary as-is
+    assert pickle.loads(pickle.dumps(spec)) == spec
+
+
+# ---------------------------------------------------------------------------
+# pid helpers + startup hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_pid_alive():
+    assert procfleet._pid_alive(os.getpid())
+    assert not procfleet._pid_alive(DEAD_PID)
+
+
+def test_cmdline_matches_requires_marker_and_worker_flag():
+    # this test process is python -m pytest: no marker, no --worker
+    assert not procfleet._cmdline_matches(os.getpid())
+    assert not procfleet._cmdline_matches(DEAD_PID)
+
+
+def _decoy_worker():
+    """A live process whose cmdline carries the worker marker — what a
+    real orphaned worker looks like to the sweep (recycled-pid-safe:
+    the marker is verified before any signal). Waits until the child
+    has exec'd: between fork and exec /proc/<pid>/cmdline still shows
+    the PARENT's argv, and a sweep racing that window would (rightly)
+    refuse to signal the unmarked pid."""
+    decoy = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)",
+         procfleet.WORKER_MARKER, "--worker"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 10.0
+    while not procfleet._cmdline_matches(decoy.pid):
+        if time.monotonic() > deadline:  # pragma: no cover - diagnostics
+            decoy.kill()
+            raise RuntimeError("decoy worker never exec'd")
+        time.sleep(0.01)
+    return decoy
+
+
+def test_sweep_reaps_orphans_and_stale_sockets(tmp_path):
+    run_root = tmp_path / "procfleet"
+    stale = run_root / "run-crashed"
+    stale.mkdir(parents=True)
+    (stale / "fleet.pid").write_text(str(DEAD_PID))  # owner is dead
+    (stale / "worker-0.g1.sock").write_text("")
+    (stale / "worker-1.g1.sock").write_text("")
+    decoy = _decoy_worker()
+    (stale / "worker-0.pid").write_text(str(decoy.pid))
+    (stale / "worker-1.pid").write_text(str(DEAD_PID))  # already gone
+
+    fleet = ProcessFleet(make_worker_spec({}, []), 2,
+                         run_root=str(run_root))
+    try:
+        fleet._sweep_stale_runs()
+    finally:
+        if decoy.poll() is None:
+            decoy.kill()
+    assert decoy.wait(10) == -signal.SIGKILL
+    assert fleet.counts["orphans_reaped"] == 1
+    assert fleet.counts["stale_sockets_swept"] == 2
+    assert not stale.exists()
+
+
+def test_sweep_leaves_live_fleet_alone(tmp_path):
+    run_root = tmp_path / "procfleet"
+    live = run_root / "run-live"
+    live.mkdir(parents=True)
+    (live / "fleet.pid").write_text(str(os.getpid()))  # owner: us, alive
+    (live / "worker-0.g1.sock").write_text("")
+    decoy = _decoy_worker()
+    (live / "worker-0.pid").write_text(str(decoy.pid))
+
+    fleet = ProcessFleet(make_worker_spec({}, []), 2,
+                         run_root=str(run_root))
+    try:
+        fleet._sweep_stale_runs()
+        assert decoy.poll() is None  # NOT killed: the dir has an owner
+    finally:
+        decoy.kill()
+        decoy.wait(10)
+    assert fleet.counts["orphans_reaped"] == 0
+    assert fleet.counts["stale_sockets_swept"] == 0
+    assert (live / "worker-0.g1.sock").exists()
+
+
+def test_sweep_never_signals_unmarked_pid(tmp_path):
+    # a recycled pid (alive, but NOT a worker cmdline) must not be
+    # signalled: fabricate a stale dir pointing at a plain sleeper
+    run_root = tmp_path / "procfleet"
+    stale = run_root / "run-crashed"
+    stale.mkdir(parents=True)
+    (stale / "fleet.pid").write_text(str(DEAD_PID))
+    bystander = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    (stale / "worker-0.pid").write_text(str(bystander.pid))
+
+    fleet = ProcessFleet(make_worker_spec({}, []), 2,
+                         run_root=str(run_root))
+    try:
+        fleet._sweep_stale_runs()
+        assert bystander.poll() is None  # still running: marker mismatch
+    finally:
+        bystander.kill()
+        bystander.wait(10)
+    assert fleet.counts["orphans_reaped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# artifact schema
+# ---------------------------------------------------------------------------
+
+
+def _healthy_record():
+    return {
+        "metric": "procfleet_drill_wall",
+        "value": 4.2,
+        "unit": "s",
+        "p50_ms": 20.0,
+        "p99_ms": 80.0,
+        "throughput_rps": 12.0,
+        "n_requests": 48,
+        "n_served": 48,
+        "bit_identical": {"checked": 48, "mismatches": 0},
+        "procfleet": {
+            "n_workers": 2,
+            "worker_deaths": 2,
+            "restarts": 2,
+            "failovers": 3,
+            "lost_requests": 0,
+            "failover_ms": 13.5,
+            "breaker_cycle": ["open", "half_open", "closed"],
+            "per_worker": [
+                {"id": 0, "served": 25, "qps": 6.0},
+                {"id": 1, "served": 23, "qps": 5.5},
+            ],
+            "health_transitions": [
+                {"t": 1.0, "owner": 1, "from": "live", "to": "revoked",
+                 "via": "missed"},
+            ],
+            "orphans": {"orphans_reaped": 1, "stale_sockets_swept": 1},
+            "mid_l2_kill": {"killed_mid_read": True,
+                            "row_bit_identical": True},
+            "wire": {"heartbeats": 120},
+        },
+        "manifest": {
+            "schema": None,
+            "timestamp_utc": "2026-01-01T00:00:00Z",
+            "device": {"platform": "cpu"},
+            "git_sha": "deadbeef",
+            "env": {},
+            "baseline_source": "none",
+        },
+    }
+
+
+def test_validate_procfleet_artifact_healthy():
+    assert validate_procfleet_artifact(_healthy_record()) == []
+
+
+@pytest.mark.parametrize("doctor,needle", [
+    (lambda r: r["procfleet"].__setitem__("lost_requests", 1),
+     "lost_requests"),
+    (lambda r: r["procfleet"].__setitem__("worker_deaths", 0),
+     "killed no worker"),
+    (lambda r: r["procfleet"].__setitem__("restarts", 0),
+     "restarted no worker"),
+    (lambda r: r["procfleet"].__setitem__("n_workers", 1),
+     "cannot fail over"),
+    (lambda r: r["procfleet"].__setitem__(
+        "breaker_cycle", ["open", "half_open"]), "breaker cycle"),
+    (lambda r: r["procfleet"].__setitem__("failover_ms", None),
+     "failover_ms"),
+    (lambda r: r["procfleet"].pop("mid_l2_kill"), "mid_l2_kill"),
+    (lambda r: r["procfleet"]["mid_l2_kill"].__setitem__(
+        "killed_mid_read", False), "never landed its kill"),
+    (lambda r: r["procfleet"]["mid_l2_kill"].__setitem__(
+        "row_bit_identical", False), "torn or stale row"),
+    (lambda r: r["procfleet"].__setitem__("wire", {"heartbeats": 0}),
+     "heartbeats"),
+    (lambda r: r["procfleet"]["per_worker"].pop(),
+     "per_worker"),
+    (lambda r: r["bit_identical"].__setitem__("mismatches", 3),
+     "bit-identity audit failed"),
+    (lambda r: r.__setitem__("p99_ms", 1.0), "p99_ms"),
+    (lambda r: r.pop("procfleet"), "missing procfleet block"),
+])
+def test_validate_procfleet_artifact_trips(doctor, needle):
+    record = _healthy_record()
+    doctor(record)
+    problems = validate_procfleet_artifact(record)
+    assert problems, f"doctored record passed: {needle}"
+    assert any(needle in p for p in problems), problems

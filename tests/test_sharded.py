@@ -85,7 +85,13 @@ def test_pad_to_shards():
     "spmd_mode",
     ["shard_map", pytest.param("gspmd", marks=pytest.mark.slow)],
 )
-@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize(
+    "backend",
+    # planar is the TPU-relevant backend; the jax-backend variant is
+    # the same sharding at different dtypes (covered single-device in
+    # test_core/test_api) and rides -m slow per the tier-1 budget
+    [pytest.param("jax", marks=pytest.mark.slow), "planar"],
+)
 def test_sharded_roundtrip_accuracy(backend, spmd_mode):
     mesh = make_facet_mesh()
     dtype = np.float64 if backend == "planar" else None
@@ -100,7 +106,12 @@ def test_sharded_roundtrip_accuracy(backend, spmd_mode):
     assert len(BF_Fs.sharding.device_set) == 8
 
 
-@pytest.mark.parametrize("spmd_mode", ["shard_map", "gspmd"])
+@pytest.mark.parametrize(
+    "spmd_mode",
+    # gspmd is the same math under the compiler's partitioner — kept,
+    # but -m slow like the other gspmd duplicates (tier-1 budget)
+    ["shard_map", pytest.param("gspmd", marks=pytest.mark.slow)],
+)
 def test_sharded_matches_single_device(spmd_mode):
     mesh = make_facet_mesh()
     cfg_mesh = SwiftlyConfig(backend="jax", mesh=mesh, spmd_mode=spmd_mode,

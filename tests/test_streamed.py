@@ -307,7 +307,12 @@ def test_complex_facet_fallback_matches():
     )
 
 
-@pytest.mark.parametrize("backend", ["jax", "planar"])
+@pytest.mark.parametrize(
+    "backend",
+    # planar keeps both facet_group sizes in tier-1; the jax-backend
+    # pair is the same slab walk at complex dtype and rides -m slow
+    [pytest.param("jax", marks=pytest.mark.slow), "planar"],
+)
 @pytest.mark.parametrize("facet_group", [1, 2])
 def test_facet_slab_streaming_matches(backend, facet_group):
     """Facet-slab-streamed column groups == facets-resident sampled path
@@ -640,7 +645,19 @@ def test_sampled_backward_checkpoint(tmp_path):
         restore_streamed_backward_state(path, b3)
 
 
-@pytest.mark.parametrize("fold_mode", ["sampled", "ct", "fft"])
+@pytest.mark.parametrize(
+    "fold_mode",
+    [
+        "sampled",
+        # the ct/fft mesh variants run the same facet-local shard_map
+        # wrapping at a different fold body; single-device fold-mode
+        # parity keeps its own tier-1 coverage
+        # (test_sampled_backward_matches_fft_backward), so these ride
+        # -m slow per the tier-1 budget
+        pytest.param("ct", marks=pytest.mark.slow),
+        pytest.param("fft", marks=pytest.mark.slow),
+    ],
+)
 def test_sampled_backward_mesh_matches_single_device(
     fold_mode, monkeypatch
 ):
