@@ -3080,8 +3080,19 @@ def procfleet_bench(smoke_mode=False):
     path's reference — cache rows vs the parent's own recorded stream
     (the exact bytes the workers mmap), compute results vs per-request
     `get_subgrid_task` on a fresh forward — plus a cross-program
-    allclose guard against wrong-row serving. The artifact's
-    ``procfleet`` block is validated by
+    allclose guard against wrong-row serving.
+
+    The distributed observability plane runs throughout: every worker
+    ships cumulative TELEMETRY frames on the heartbeat cadence into a
+    `ControlTower` (``fleet_telemetry`` totals sum exactly across
+    processes, surviving the deaths through the retired-generation
+    ledger), traces its half of every request so
+    `ProcessFleet.merged_trace` emits ONE timeline across all pids
+    (clocks aligned via the HELLO offset estimates, ±rtt/2), and
+    persists its flight-recorder ring as a crash-safe black box — the
+    artifact's post-mortem shows each SIGKILL victim's OWN last events
+    (the L2 dwell it held, the request in flight), exhumed by the
+    supervisor. The artifact's ``procfleet`` block is validated by
     `obs.validate_procfleet_artifact`; with ``--smoke`` the drill
     outcomes are asserted and the leg exits nonzero on any problem
     (wired into tier-1 via tests/test_bench_smoke.py).
@@ -3102,6 +3113,7 @@ def procfleet_bench(smoke_mode=False):
     from swiftly_tpu.delta import IncrementalForward
     from swiftly_tpu.models import SWIFT_CONFIGS
     from swiftly_tpu.obs import (
+        ControlTower,
         metrics,
         run_manifest,
         validate_procfleet_artifact,
@@ -3119,6 +3131,10 @@ def procfleet_bench(smoke_mode=False):
     )
     enable_compilation_cache()
     trace_path = _maybe_enable_trace()
+    if not otrace.enabled():
+        # the merged cross-process timeline needs the router's tracer
+        # live even when --trace didn't ask for an export on disk
+        otrace.enable()
     orecorder = _maybe_enable_recorder()
     out_path = os.environ.get("BENCH_PROCFLEET_OUT", "BENCH_procfleet.json")
     if smoke_mode:
@@ -3196,8 +3212,13 @@ def procfleet_bench(smoke_mode=False):
         breaker_threshold=3, breaker_reopen_s=0.3,
         breaker_max_reopen_s=4.0, half_open_probes=2,
         restart_backoff_s=0.2, restart_backoff_max_s=2.0,
-        boot_deadline_s=240.0,
+        boot_deadline_s=240.0, worker_trace=True,
     )
+    # the distributed observability plane: per-worker TELEMETRY
+    # sources + fleet signals/SLOs under one control tower, ticked by
+    # the fleet's own supervisor
+    tower = ControlTower()
+    fleet.register_tower(tower)
 
     workload, hot_off0 = _zipf_workload(
         subgrid_configs, per_phase, seed, zipf_s
@@ -3271,6 +3292,13 @@ def procfleet_bench(smoke_mode=False):
             w = fleet.worker(victim)
             if w.lease is not None and w.lease.revoked or w.dead:
                 break
+            time.sleep(0.005)
+        # wait for EXHUMATION: _on_revoked digs up the victim's black
+        # box and folds its tail into the parent's recorder — the dump
+        # below must show the victim's own story, not just the silence
+        deadline = time.time() + 10.0
+        while (time.time() < deadline
+               and fleet.counts["blackbox_exhumed"] < 1):
             time.sleep(0.005)
         kill_post_mortem = (
             orecorder.post_mortem(
@@ -3349,6 +3377,20 @@ def procfleet_bench(smoke_mode=False):
             "victim": victim2,
             "served_by_path": None if res2 is None else res2.path,
         }
+        # wait for the SECOND exhumation (victim2's black box holds
+        # the dwell + in-flight request the kill interrupted), then
+        # capture the post-mortem that must show them
+        deadline = time.time() + 15.0
+        while (time.time() < deadline
+               and fleet.counts["blackbox_exhumed"] < 2):
+            time.sleep(0.005)
+        final_post_mortem = (
+            orecorder.post_mortem(
+                "WorkerSIGKILLedMidL2Read",
+                reason=f"worker {victim2} killed -9 inside an L2 read",
+            )
+            if orecorder is not None else None
+        )
         # let victim2's restart land so stop() drains a whole fleet
         deadline = time.time() + 60.0
         while time.time() < deadline:
@@ -3361,6 +3403,17 @@ def procfleet_bench(smoke_mode=False):
         wall = time.time() - t0
         stats = fleet.stats(wall_s=wall)
         lost = fleet.lost_requests()
+        fleet_telemetry = tower.fleet_telemetry()
+        alerts_block = tower.alerts_block()
+        # merge the fleet's timelines while the run dir still exists
+        # (workers atomically publish on the heartbeat cadence,
+        # throttled to one save per 0.5s — give the tail one beat)
+        time.sleep(0.6)
+        try:
+            merged = fleet.merged_trace()
+        except Exception:
+            log.exception("cross-process trace merge failed")
+            merged = None
     finally:
         try:
             fleet.stop(drain=True)
@@ -3416,6 +3469,44 @@ def procfleet_bench(smoke_mode=False):
     victim_cycle = [
         t["to"] for t in stats["breakers"][victim]["transitions"]
     ]
+
+    # -- distributed observability plane: trace merge + black box -----
+    merged_path = None
+    trace_merge = None
+    if merged is not None:
+        merged_path = (
+            os.path.splitext(out_path)[0] + "_merged_trace.json")
+        with open(merged_path, "w") as fh:
+            json.dump(merged, fh)
+        meta = merged.get("otherData") or {}
+        router_pid = os.getpid()
+        cross_requests = sum(
+            1 for ev in merged.get("traceEvents") or []
+            if isinstance(ev, dict) and ev.get("ph") == "X"
+            and (ev.get("args") or {}).get("xpid") == router_pid
+        )
+        trace_merge = {
+            "n_processes": meta.get("n_processes"),
+            "pids": meta.get("pids"),
+            "n_spans": meta.get("n_spans"),
+            "clock_offsets": meta.get("clock_offsets"),
+            "cross_process_requests": cross_requests,
+            "merged_trace_path": merged_path,
+        }
+
+    def _victim_event(pm, rid, name):
+        """Did the rid's OWN `name` event (exhumed from its black box,
+        `[worker-<rid> ...]`-prefixed) reach this post-mortem tail?"""
+        return any(
+            isinstance(e, dict) and e.get("name") == name
+            and f"[worker-{rid} " in str(e.get("detail", ""))
+            for e in ((pm or {}).get("events") or [])
+        )
+
+    victim_events_in_pm = bool(
+        _victim_event(final_post_mortem, victim2, "proc.l2_dwell")
+        or _victim_event(kill_post_mortem, victim, "proc.request")
+    )
     n_cols = len({sg.off0 for sg in subgrid_configs})
     failover_ms = stats["failover_ms"]
     record = {
@@ -3468,7 +3559,16 @@ def procfleet_bench(smoke_mode=False):
             "wire": {
                 "heartbeats": stats["heartbeats"],
             },
+            "telemetry": stats["telemetry"],
+            "clock_offsets": stats["clock_offsets"],
+            "trace_merge": trace_merge,
+            "black_box": {
+                **stats["black_box"],
+                "victim_events_in_post_mortem": victim_events_in_pm,
+            },
         },
+        "fleet_telemetry": fleet_telemetry,
+        "alerts": alerts_block,
         "zipf": {"s": zipf_s, "n_columns": n_cols, "seed": seed},
         "n_subgrids_cover": len(subgrid_configs),
         "manifest": run_manifest(
@@ -3482,7 +3582,8 @@ def procfleet_bench(smoke_mode=False):
             reason=f"worker {victim} pid {killed_pid} killed -9",
         )
         record["post_mortem"] = dict(
-            kill_post_mortem
+            final_post_mortem
+            or kill_post_mortem
             or orecorder.post_mortem("drill_complete")
         )
         record["post_mortem"]["dump_path"] = pm_path
@@ -3569,6 +3670,47 @@ def procfleet_bench(smoke_mode=False):
                 f"p99 did not recover: {p99_after}ms after vs "
                 f"{p99_before}ms before (> 3x)"
             )
+        # observability-plane outcomes: the victim's OWN story must
+        # survive the kill, and one timeline must span the fleet
+        if not _victim_event(final_post_mortem, victim2,
+                             "proc.l2_dwell"):
+            problems.append(
+                "the mid-L2-read victim's own proc.l2_dwell event "
+                "never reached the parent's post-mortem (black box "
+                "lost the dwell)"
+            )
+        if not _victim_event(final_post_mortem, victim2,
+                             "proc.request"):
+            problems.append(
+                "the mid-L2-read victim's in-flight proc.request "
+                "never reached the parent's post-mortem"
+            )
+        if trace_merge is None:
+            problems.append("cross-process trace merge produced "
+                            "nothing")
+        else:
+            if (trace_merge["n_processes"] or 0) < 2:
+                problems.append(
+                    f"merged timeline spans "
+                    f"{trace_merge['n_processes']!r} process(es), "
+                    "expected >= 2"
+                )
+            if trace_merge["cross_process_requests"] < 1:
+                problems.append(
+                    "no request span crossed a process boundary in "
+                    "the merged timeline"
+                )
+        if len(stats["clock_offsets"]) < n_workers:
+            problems.append(
+                f"clock offsets estimated for only "
+                f"{len(stats['clock_offsets'])} of {n_workers} workers"
+            )
+        cov = stats["telemetry"]["coverage"]
+        if not isinstance(cov, (int, float)) or cov < 0.5:
+            problems.append(
+                f"telemetry coverage {cov!r}: TELEMETRY frames "
+                "vouch for less than half the workers' live time"
+            )
     with open(out_path, "w") as fh:
         json.dump(record, fh, indent=2)
     if smoke_mode:
@@ -3591,6 +3733,15 @@ def procfleet_bench(smoke_mode=False):
                     "orphans_reaped": orphans["orphans_reaped"],
                     "stale_sockets_swept": orphans["stale_sockets_swept"],
                     "heartbeats": stats["heartbeats"],
+                    "telemetry_frames": stats["telemetry"]["frames"],
+                    "telemetry_coverage": stats["telemetry"]["coverage"],
+                    "blackbox_exhumed": stats["blackbox_exhumed"],
+                    "merged_processes": (
+                        None if trace_merge is None
+                        else trace_merge["n_processes"]),
+                    "cross_process_requests": (
+                        None if trace_merge is None
+                        else trace_merge["cross_process_requests"]),
                     "problems": problems,
                 }
             ),

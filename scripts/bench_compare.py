@@ -256,6 +256,13 @@ SENTINELS = [
         "source_pr": 19,
         "applies_to": "process-fleet (--procfleet) SIGKILL drill legs",
     },
+    {
+        "name": "procfleet.telemetry_coverage",
+        "direction": "higher",
+        "threshold": "--threshold (default 20%) below best reference",
+        "source_pr": 20,
+        "applies_to": "process-fleet (--procfleet) SIGKILL drill legs",
+    },
 ]
 
 # metric strings look like
@@ -323,7 +330,7 @@ def compare(latest_records, reference_records, threshold=0.2):
             {"wall": None, "mfu": None, "p99": None, "rps": None,
              "se": None, "dse": None, "rms": None, "ro": None,
              "chr": None, "sc": None, "vp99": None, "vks": None,
-             "pfo": None, "plr": None,
+             "pfo": None, "plr": None, "ptc": None,
              "n": 0},
         )
         bucket["n"] += 1
@@ -388,6 +395,11 @@ def compare(latest_records, reference_records, threshold=0.2):
         if isinstance(plr, int) and not isinstance(plr, bool) and plr >= 0:
             if bucket["plr"] is None or plr < bucket["plr"]:
                 bucket["plr"] = plr
+        ptc = ((rec.get("procfleet") or {}).get("telemetry")
+               or {}).get("coverage")
+        if isinstance(ptc, (int, float)) and 0 < ptc <= 1:
+            if bucket["ptc"] is None or ptc > bucket["ptc"]:
+                bucket["ptc"] = ptc
 
     legs, regressions, skipped = [], [], []
     for rec in latest_records:
@@ -623,6 +635,21 @@ def compare(latest_records, reference_records, threshold=0.2):
                     f"{plr} lost request(s) vs {ref['plr']} in the "
                     "best reference — the process fleet's zero-loss "
                     "failover claim regressed"
+                )
+        ptc = ((rec.get("procfleet") or {}).get("telemetry")
+               or {}).get("coverage")
+        if isinstance(ptc, (int, float)) and 0 < ptc <= 1:
+            verdict["procfleet_telemetry_coverage"] = ptc
+            verdict["ref_procfleet_telemetry_coverage"] = ref["ptc"]
+            if (
+                ref["ptc"] is not None
+                and ptc < ref["ptc"] * (1.0 - threshold)
+            ):
+                verdict["problems"].append(
+                    f"telemetry coverage {ptc:.4g} is "
+                    f"{100 * (1 - ptc / ref['ptc']):.1f}% below best "
+                    f"reference {ref['ptc']:.4g} — TELEMETRY frames "
+                    "stopped covering the workers' live time"
                 )
         # precision legs: accuracy sentinel (lower is better)
         rms = rec.get("rms_vs_dft_oracle")

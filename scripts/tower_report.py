@@ -22,6 +22,15 @@ re-validated on the way through (`obs.validate_fleet_telemetry_artifact`
 / `obs.validate_alerts_artifact` — a doctored totals block turns the
 exit code nonzero).
 
+``--procfleet`` artifacts (``bench.py --procfleet``) additionally
+carry the distributed observability plane: per-worker telemetry
+sources inside ``fleet_telemetry`` (``worker-<rid>`` rows merged from
+TELEMETRY frames + the retired-generation ledger), the per-worker
+clock offsets estimated from the HELLO exchange (± rtt/2), the
+TELEMETRY-frame coverage counters, and the black-box exhumation
+summaries the supervisor recovered from dead workers. Those render as
+one extra "process fleet" section — no new flag needed.
+
 Usage:
     python scripts/tower_report.py BENCH_fleet.json [--events 16]
         [--json]
@@ -74,6 +83,16 @@ def summarize(record, events=16):
             "closed": alerts.get("closed"),
             "open": alerts.get("open"),
             "events": (alerts.get("events") or [])[-events:],
+        }
+    pf = record.get("procfleet")
+    if isinstance(pf, dict):
+        out["procfleet"] = {
+            "n_workers": pf.get("n_workers"),
+            "worker_deaths": pf.get("worker_deaths"),
+            "telemetry": pf.get("telemetry"),
+            "clock_offsets": pf.get("clock_offsets"),
+            "black_box": pf.get("black_box"),
+            "trace_merge": pf.get("trace_merge"),
         }
     pm = record.get("post_mortem")
     if isinstance(pm, dict):
@@ -152,6 +171,51 @@ def _render_alerts(alerts):
     return lines
 
 
+def _render_procfleet(pf):
+    lines = [
+        f"process fleet: {pf.get('n_workers', '?')} worker(s), "
+        f"{pf.get('worker_deaths', 0)} death(s)"
+    ]
+    tel = pf.get("telemetry") or {}
+    if tel:
+        cov = tel.get("coverage")
+        lines.append(
+            f"  telemetry: {tel.get('frames', 0)} frame(s), "
+            f"{tel.get('zombie_frames', 0)} zombie-gated, "
+            f"{tel.get('retired_generations', 0)} retired "
+            "generation(s), coverage "
+            + (f"{cov:.3f}" if isinstance(cov, (int, float)) else "-")
+        )
+    offsets = pf.get("clock_offsets") or {}
+    if offsets:
+        lines.append("  clock offsets (vs the router):")
+        for rid, off in sorted(offsets.items()):
+            lines.append(
+                f"    worker-{rid} (pid {off.get('pid', '?')}, "
+                f"g{off.get('generation', '?')}): "
+                f"offset {off.get('offset_s', 0.0):+.6f}s "
+                f"± rtt/2 {off.get('rtt_s', 0.0) / 2:.6f}s"
+            )
+    bb = pf.get("black_box") or {}
+    for ex in bb.get("exhumed") or []:
+        lines.append(
+            f"  black box: worker-{ex.get('rid')} "
+            f"g{ex.get('generation')} exhumed, "
+            f"{ex.get('n_events', 0)} event(s)"
+            + (" (TORN INDEX, fell back a generation)"
+               if ex.get("torn_index") else "")
+        )
+    tm = pf.get("trace_merge") or {}
+    if tm:
+        lines.append(
+            f"  trace merge: {tm.get('n_processes', '?')} process(es) "
+            f"{tm.get('pids')}, "
+            f"{tm.get('cross_process_requests', 0)} cross-process "
+            "request span(s)"
+        )
+    return lines
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="fleet timeline + alerts + post-mortem from a "
@@ -196,6 +260,10 @@ def main(argv=None):
     if "alerts" in summary:
         print()
         print("\n".join(_render_alerts(summary["alerts"])))
+        rendered = True
+    if "procfleet" in summary:
+        print()
+        print("\n".join(_render_procfleet(summary["procfleet"])))
         rendered = True
     if "post_mortem" in summary:
         print()

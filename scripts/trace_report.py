@@ -20,9 +20,15 @@ one row per named fleet source (``replica-N``, ``fleet-supervisor``,
 request journeys), the view the control tower's track naming exists
 for.
 
+``--by-process`` groups per pid — the view for the process fleet's
+MERGED timeline (`ProcessFleet.merged_trace`): one row per process
+(``router``, ``worker-N.gG``), labelled from the merge's
+``process_name`` metadata, with the recorded clock offsets echoed so
+the cross-process alignment uncertainty is visible next to the rows.
+
 Usage:
     python scripts/trace_report.py BENCH_trace.json [--top 10] [--json]
-        [--by-source]
+        [--by-source] [--by-process]
 """
 
 import argparse
@@ -62,6 +68,11 @@ def main(argv=None):
         help="group attribution per Perfetto track (replica-N, "
         "fleet-supervisor, request journeys) instead of fleet-wide",
     )
+    parser.add_argument(
+        "--by-process", action="store_true", dest="by_process",
+        help="group attribution per process (router, worker-N.gG) — "
+        "for merged process-fleet timelines",
+    )
     args = parser.parse_args(argv)
 
     trace = report.load_trace(args.trace)
@@ -72,6 +83,33 @@ def main(argv=None):
             + "; ".join(problems[:5]),
             file=sys.stderr,
         )
+    if args.by_process:
+        rows = report.by_process(trace, top_k=args.top)
+        offsets = (trace.get("otherData") or {}).get("clock_offsets")
+        if args.as_json:
+            print(json.dumps({"by_process": rows,
+                              "clock_offsets": offsets}))
+            return 0 if not problems else 1
+        print(f"trace: {args.trace} — {len(rows)} process(es)")
+        for row in rows:
+            print(
+                f"\n{row['label']} (pid {row['pid']}): "
+                f"{row['spans']} span(s), {row['events']} event(s), "
+                f"self {row['self_s']:.4f}s"
+            )
+            for st in row["top"]:
+                print(
+                    f"  {st['name']:<28} x{st['count']:<6} "
+                    f"self {st['self_s']:>10.4f}s"
+                )
+        if offsets:
+            print("\nclock offsets (vs the base process):")
+            for pid, off in sorted(offsets.items()):
+                print(
+                    f"  pid {pid}: offset {off.get('offset_s', 0.0):+.6f}s"
+                    f" ± rtt/2 {off.get('rtt_s', 0.0) / 2:.6f}s"
+                )
+        return 0 if not problems else 1
     if args.by_source:
         rows = report.by_source(trace, top_k=args.top)
         if args.as_json:

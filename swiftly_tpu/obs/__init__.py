@@ -62,7 +62,12 @@ from .manifest import (
     validate_serve_artifact,
     validate_vis_artifact,
 )
-from .report import summarize_trace, validate_trace_artifact
+from .report import (
+    by_process,
+    merge_traces,
+    summarize_trace,
+    validate_trace_artifact,
+)
 from .tower import (
     SLO,
     ControlTower,
@@ -75,7 +80,9 @@ __all__ = [
     "Heartbeat",
     "PartialArtifactWriter",
     "SLO",
+    "by_process",
     "ledger",
+    "merge_traces",
     "metrics",
     "recorder",
     "report",

@@ -40,13 +40,14 @@ class Heartbeat:
     """
 
     def __init__(self, total, label="units", interval_s=30.0,
-                 log=None, tower=None):
+                 log=None, tower=None, procfleet=None):
         self.total = int(total)
         self.label = label
         self.interval_s = float(interval_s)
         self.done = 0
         self._log = log or logger
         self._tower = tower
+        self._procfleet = procfleet
         self._t0 = time.time()
         self._last_emit = 0.0  # first update() emits immediately
 
@@ -75,6 +76,10 @@ class Heartbeat:
             # open alerts, queue depth, brownout rung — already-sampled
             # tower state, no source calls on this path
             fields = {**self._tower.heartbeat_fields(), **fields}
+        if self._procfleet is not None:
+            # process-fleet state: live workers, summed generations,
+            # open alert count (`ProcessFleet.heartbeat_fields`)
+            fields = {**self._procfleet.heartbeat_fields(), **fields}
         self._log.info(
             "%s %d/%d (%.2f/s, elapsed %.0fs%s)",
             self.label, self.done, self.total, rate, elapsed,

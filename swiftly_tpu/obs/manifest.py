@@ -623,6 +623,10 @@ _PROCFLEET_BLOCK_FIELDS = (
     "orphans",
     "mid_l2_kill",
     "wire",
+    "telemetry",
+    "clock_offsets",
+    "trace_merge",
+    "black_box",
 )
 
 
@@ -638,6 +642,17 @@ def validate_procfleet_artifact(record):
     read and still served the row bit-identically, and a ``wire`` block
     whose heartbeats actually flowed (a drill whose leases never beat
     proved nothing).
+
+    The distributed observability plane extends the contract: a
+    ``fleet_telemetry`` block whose cross-process totals sum exactly
+    (`obs.tower.validate_fleet_telemetry_artifact`), a ``telemetry``
+    block with frames flowing and coverage in [0, 1], HELLO-estimated
+    ``clock_offsets`` with their RTT uncertainty, a ``trace_merge``
+    summary proving one timeline across ≥2 processes, and a
+    ``black_box`` block showing an exhumed worker's own events folded
+    into the parent's post-mortem. Per-worker heartbeat payloads
+    (``last_stats``) are schema-checked: a worker shipping garbage
+    stats trips the validator, not a downstream dashboard.
     """
     problems = validate_artifact(record, require_baseline=False)
     for field in PROCFLEET_ARTIFACT_FIELDS:
@@ -709,6 +724,25 @@ def validate_procfleet_artifact(record):
             ):
                 problems.append("per_worker rows need {id, served, qps}")
                 break
+        for row in per:
+            if not isinstance(row, dict):
+                continue
+            stats = row.get("last_stats")
+            if stats is None:
+                continue  # a worker that never beat has no payload
+            if not isinstance(stats, dict):
+                problems.append(
+                    f"per_worker[{row.get('id')!r}].last_stats is "
+                    f"{type(stats).__name__}, expected a heartbeat dict"
+                )
+                continue
+            for counter in ("beats", "served", "pending"):
+                v = stats.get(counter)
+                if not isinstance(v, int) or v < 0:
+                    problems.append(
+                        f"per_worker[{row.get('id')!r}].last_stats."
+                        f"{counter} = {v!r} is not a counter"
+                    )
     orphans = pf.get("orphans")
     if orphans is not None:
         if not isinstance(orphans, dict) or not (
@@ -747,6 +781,109 @@ def validate_procfleet_artifact(record):
                 "wire block shows no heartbeats — leases never beat "
                 "on the wire"
             )
+    # -- distributed observability plane --------------------------------
+    if "fleet_telemetry" in record:
+        from .tower import validate_fleet_telemetry_artifact
+
+        problems.extend(validate_fleet_telemetry_artifact(record))
+    else:
+        problems.append(
+            "missing fleet_telemetry block — the fleet ran without "
+            "its cross-process telemetry plane"
+        )
+    tel = pf.get("telemetry")
+    if tel is not None:
+        if not isinstance(tel, dict):
+            problems.append("procfleet telemetry block is not a dict")
+        else:
+            frames = tel.get("frames")
+            if not isinstance(frames, int) or frames < 1:
+                problems.append(
+                    f"telemetry.frames {frames!r}: no TELEMETRY frame "
+                    "ever crossed the wire"
+                )
+            zombies = tel.get("zombie_frames")
+            if not isinstance(zombies, int) or zombies < 0:
+                problems.append(
+                    f"telemetry.zombie_frames {zombies!r} is not a count"
+                )
+            cov = tel.get("coverage")
+            if not isinstance(cov, (int, float)) or not 0.0 <= cov <= 1.0:
+                problems.append(
+                    f"telemetry.coverage {cov!r} is not in [0, 1]"
+                )
+    offs = pf.get("clock_offsets")
+    if offs is not None:
+        if not isinstance(offs, dict) or not offs:
+            problems.append(
+                "clock_offsets is empty — no HELLO exchange estimated "
+                "a worker clock"
+            )
+        else:
+            for rid, off in offs.items():
+                if not isinstance(off, dict) or not isinstance(
+                    off.get("offset_s"), (int, float)
+                ):
+                    problems.append(
+                        f"clock_offsets[{rid!r}] has no offset_s number"
+                    )
+                    continue
+                rtt = off.get("rtt_s")
+                if not isinstance(rtt, (int, float)) or rtt < 0:
+                    problems.append(
+                        f"clock_offsets[{rid!r}].rtt_s {rtt!r} is not "
+                        "a non-negative uncertainty"
+                    )
+    tm = pf.get("trace_merge")
+    if tm is not None:
+        if not isinstance(tm, dict):
+            problems.append("trace_merge block is not a dict")
+        else:
+            nproc = tm.get("n_processes")
+            if not isinstance(nproc, int) or nproc < 2:
+                problems.append(
+                    f"trace_merge.n_processes {nproc!r} < 2 — one "
+                    "process is not a merged timeline"
+                )
+            pids = tm.get("pids")
+            if not isinstance(pids, list) or (
+                isinstance(nproc, int) and len(pids) != nproc
+            ):
+                problems.append(
+                    f"trace_merge.pids {pids!r} does not list "
+                    f"{nproc!r} process(es)"
+                )
+            xreq = tm.get("cross_process_requests")
+            if not isinstance(xreq, int) or xreq < 1:
+                problems.append(
+                    f"trace_merge.cross_process_requests {xreq!r}: no "
+                    "request span crossed a process boundary"
+                )
+    bb = pf.get("black_box")
+    if bb is not None:
+        if not isinstance(bb, dict):
+            problems.append("black_box block is not a dict")
+        else:
+            exhumed = bb.get("exhumed")
+            if not isinstance(exhumed, list) or not exhumed:
+                problems.append(
+                    "black_box.exhumed is empty — no dead worker's "
+                    "ring was recovered"
+                )
+            else:
+                for i, box in enumerate(exhumed):
+                    if not isinstance(box, dict) or not (
+                        {"rid", "generation", "n_events"} <= set(box)
+                    ):
+                        problems.append(
+                            f"black_box.exhumed[{i}] needs "
+                            "{rid, generation, n_events}"
+                        )
+            if bb.get("victim_events_in_post_mortem") is not True:
+                problems.append(
+                    "black_box: the victim's own events never reached "
+                    "the parent's post-mortem"
+                )
     return problems
 
 

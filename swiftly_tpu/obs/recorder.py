@@ -120,6 +120,21 @@ class FlightRecorder:
             if t >= cutoff
         ]
 
+    def events_since(self, t_watermark):
+        """JSON-ready events recorded after ``t_watermark`` (a relative
+        ``t`` from a previous event, or ``-1.0`` for everything), plus
+        the new watermark: ``(events, watermark)``. The incremental
+        export the process-fleet black-box flusher drains the ring with
+        — each flush ships only what the last one did not."""
+        out = []
+        last = t_watermark
+        for (t, kind, name, detail) in list(self._ring):
+            if t > t_watermark:
+                out.append({"t": round(t, 6), "kind": kind,
+                            "name": name, "detail": detail})
+                last = t  # raw clock value: rounding must not re-emit
+        return out, last
+
     def post_mortem(self, trigger, reason=None, seconds=None):
         """The JSON-ready bundle for one trigger: the recorded window,
         per-kind counts, and the non-stage event tail (the readable

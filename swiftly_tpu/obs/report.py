@@ -29,10 +29,12 @@ import json
 __all__ = [
     "TRACE_ARTIFACT_FIELDS",
     "build_tree",
+    "by_process",
     "by_source",
     "critical_path",
     "journey_stats",
     "load_trace",
+    "merge_traces",
     "self_times",
     "summarize_trace",
     "validate_trace_artifact",
@@ -40,6 +42,12 @@ __all__ = [
 ]
 
 TRACE_SCHEMA = "swiftly-tpu-trace/1"
+
+# Per-process span-id namespace stride used by `merge_traces`: each
+# non-base process's span ids are lifted into their own block so the
+# merged timeline has ONE consistent id space (per-process tracers all
+# start their id counters at 1).
+MERGE_SPAN_NS = 1 << 24
 
 
 def load_trace(path):
@@ -301,6 +309,203 @@ def by_source(trace, top_k=5):
         )[:top_k]
         rows.append({
             "tid": tid,
+            "label": g["label"],
+            "spans": g["spans"],
+            "events": g["events"],
+            "wall_s": round(g["wall_s"], 6),
+            "self_s": round(g["self_s"], 6),
+            "top": [
+                {"name": n, "count": v["count"],
+                 "self_s": round(v["self_s"], 6)}
+                for n, v in top
+            ],
+        })
+    return rows
+
+
+def merge_traces(traces, offsets=None, labels=None):
+    """ONE Perfetto timeline from per-process Chrome traces.
+
+    ``traces[0]`` is the time base (the process-fleet router); every
+    other trace's events are shifted onto its clock using the traces'
+    ``otherData.t_epoch`` anchors corrected by ``offsets`` — the
+    per-process wall-clock offsets the fleet estimated from the HELLO
+    exchange (``{pid: {"offset_s": ..., "rtt_s": ...}}``, or a bare
+    float per pid). A worker whose wall clock runs ``offset_s`` ahead
+    of the router's has that much subtracted, so a request's
+    router→worker→router journey lines up on one axis within the
+    recorded RTT uncertainty.
+
+    Span ids are namespaced per process (``MERGE_SPAN_NS`` stride, base
+    trace unshifted) so `build_tree` sees one consistent id space, and
+    worker spans carrying the fleet's cross-process trace context
+    (``args.xparent`` + ``args.xpid``) are re-parented onto the
+    originating process's span — the merged tree walks the hop.
+
+    Returns a Chrome trace dict whose ``otherData`` records the base
+    epoch, the merged pids, and the clock offsets applied.
+    """
+    traces = [t for t in traces if isinstance(t, dict)]
+    if not traces:
+        raise ValueError("merge_traces needs at least one trace")
+    offsets = offsets or {}
+
+    def _offset_s(pid):
+        off = offsets.get(pid, offsets.get(str(pid), 0.0))
+        if isinstance(off, dict):
+            return float(off.get("offset_s", 0.0) or 0.0)
+        return float(off or 0.0)
+
+    def _pids(trace):
+        return {
+            e.get("pid") for e in trace.get("traceEvents", ())
+            if isinstance(e, dict) and e.get("pid") is not None
+        }
+
+    base_epoch = float(
+        (traces[0].get("otherData") or {}).get("t_epoch") or 0.0
+    )
+    # process index per trace: the base keeps index 0 (ids unshifted)
+    pid_index = {}
+    for i, trace in enumerate(traces):
+        for pid in sorted(_pids(trace), key=str):
+            pid_index.setdefault(pid, i)
+
+    def _ns(pid, sid):
+        if not sid:
+            return 0
+        return pid_index.get(pid, 0) * MERGE_SPAN_NS + int(sid)
+
+    merged = []
+    pids = []
+    n_events = 0
+    for i, trace in enumerate(traces):
+        epoch = float(
+            (trace.get("otherData") or {}).get("t_epoch") or base_epoch
+        )
+        trace_pids = _pids(trace)
+        pids.extend(p for p in sorted(trace_pids, key=str)
+                    if p not in pids)
+        for e in trace.get("traceEvents", ()):
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            pid = e.get("pid")
+            shift_us = (
+                (epoch - _offset_s(pid) - base_epoch) * 1e6
+                if i else 0.0
+            )
+            if "ts" in e:
+                e["ts"] = round(float(e["ts"]) + shift_us, 3)
+            if e.get("ph") == "X":
+                args = dict(e.get("args") or {})
+                sid = args.get("span_id")
+                if sid is not None:
+                    args["span_id"] = _ns(pid, sid)
+                    xparent = args.get("xparent")
+                    xpid = args.get("xpid")
+                    if xparent and xpid in pid_index:
+                        # the cross-process hop: adopt the originating
+                        # process's span as the parent in the merged tree
+                        args["parent_id"] = _ns(xpid, xparent)
+                    else:
+                        args["parent_id"] = _ns(
+                            pid, args.get("parent_id", 0))
+                e["args"] = args
+            if e.get("ph") in ("i", "I"):
+                n_events += 1
+            merged.append(e)
+    meta = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {
+                "name": (labels or {}).get(
+                    pid, (labels or {}).get(str(pid), f"pid {pid}")),
+            },
+        }
+        for pid in pids
+    ]
+    clock = {}
+    for pid in pids:
+        off = offsets.get(pid, offsets.get(str(pid)))
+        if off is None:
+            continue
+        if isinstance(off, dict):
+            clock[str(pid)] = {
+                "offset_s": float(off.get("offset_s", 0.0) or 0.0),
+                "rtt_s": float(off.get("rtt_s", 0.0) or 0.0),
+            }
+        else:
+            clock[str(pid)] = {"offset_s": float(off), "rtt_s": 0.0}
+    return {
+        "traceEvents": meta + merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": TRACE_SCHEMA,
+            "t_epoch": base_epoch,
+            "n_processes": len(pids),
+            "pids": pids,
+            "clock_offsets": clock,
+            "n_spans": sum(
+                1 for e in merged if e.get("ph") == "X"
+            ),
+            "n_events": n_events,
+        },
+    }
+
+
+def by_process(trace, top_k=5):
+    """Per-process attribution: spans and instants grouped by pid, each
+    labelled with its ``"M"`` process-name metadata — the merged
+    process-fleet timeline's router/worker rows. Returns rows sorted by
+    self time, busiest process first (the `by_source` twin, one level
+    up the hierarchy)."""
+    labels = {}
+    span_pid = {}
+    for e in trace.get("traceEvents", ()):
+        if not isinstance(e, dict):
+            continue
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            labels[e.get("pid")] = (e.get("args") or {}).get("name")
+        elif e.get("ph") == "X":
+            sid = (e.get("args") or {}).get("span_id")
+            if sid is not None:
+                span_pid[sid] = e.get("pid")
+    spans = build_tree(trace)
+    selfs = self_times(spans)
+    groups = {}
+
+    def group(pid):
+        return groups.setdefault(pid, {
+            "label": labels.get(pid) or f"pid {pid}",
+            "spans": 0, "events": 0, "wall_s": 0.0, "self_s": 0.0,
+            "stages": {},
+        })
+
+    for sid, s in spans.items():
+        g = group(span_pid.get(sid))
+        g["spans"] += 1
+        g["wall_s"] += s["dur_s"]
+        g["self_s"] += selfs[sid]
+        st = g["stages"].setdefault(
+            s["name"], {"count": 0, "self_s": 0.0}
+        )
+        st["count"] += 1
+        st["self_s"] += selfs[sid]
+    for e in trace.get("traceEvents", ()):
+        if isinstance(e, dict) and e.get("ph") in ("i", "I"):
+            group(e.get("pid"))["events"] += 1
+    rows = []
+    for pid, g in sorted(
+        groups.items(), key=lambda kv: -kv[1]["self_s"]
+    ):
+        top = sorted(
+            g["stages"].items(), key=lambda kv: -kv[1]["self_s"]
+        )[:top_k]
+        rows.append({
+            "pid": pid,
             "label": g["label"],
             "spans": g["spans"],
             "events": g["events"],

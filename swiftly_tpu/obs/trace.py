@@ -394,13 +394,25 @@ class Tracer:
             },
         }
 
-    def save(self, path=None):
-        """Write the Chrome trace JSON; returns the path written."""
+    def save(self, path=None, atomic=False):
+        """Write the Chrome trace JSON; returns the path written.
+
+        ``atomic=True`` publishes via a tmp sibling + rename (the
+        `write_stream_state` discipline) so a concurrent reader — the
+        process-fleet parent merging worker timelines while the worker
+        is still serving — sees the previous complete trace or the new
+        one, never a torn file."""
         path = str(path or self.path)
         if not path:
             raise ValueError("no trace path given and none configured")
-        with open(path, "w") as fh:
-            json.dump(self.export(), fh)
+        if atomic:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(self.export(), fh)
+            os.replace(tmp, path)
+        else:
+            with open(path, "w") as fh:
+                json.dump(self.export(), fh)
         return path
 
 
@@ -504,5 +516,5 @@ def export():
     return _TRACER.export()
 
 
-def save(path=None):
-    return _TRACER.save(path)
+def save(path=None, atomic=False):
+    return _TRACER.save(path, atomic=atomic)

@@ -65,6 +65,7 @@ __all__ = [
     "FRAME_HELLO",
     "FRAME_REQUEST",
     "FRAME_RESULT",
+    "FRAME_TELEMETRY",
     "HEADER_BYTES",
     "MAX_FRAME_BYTES",
     "WIRE_VERSION",
@@ -100,10 +101,11 @@ FRAME_HEARTBEAT = 4
 FRAME_DRAIN = 5
 FRAME_ERROR = 6
 FRAME_CONTROL = 7
+FRAME_TELEMETRY = 8
 
 _FRAME_TYPES = frozenset((
     FRAME_HELLO, FRAME_REQUEST, FRAME_RESULT, FRAME_HEARTBEAT,
-    FRAME_DRAIN, FRAME_ERROR, FRAME_CONTROL,
+    FRAME_DRAIN, FRAME_ERROR, FRAME_CONTROL, FRAME_TELEMETRY,
 ))
 
 

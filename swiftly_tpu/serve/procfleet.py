@@ -42,12 +42,31 @@ frames. What the thread fleet asserted, this tier must *survive*:
   reaped (``proc.orphans_reaped``), mirroring `SpillCache`'s
   orphaned-``.tmp`` sweep.
 
+* **A distributed observability plane.** Observability must not stop
+  at the process boundary: each worker ships cumulative
+  ``TELEMETRY`` frames (its metrics counters + stage timers) every
+  heartbeat, and the parent registers one ``worker-<rid>`` source per
+  slot (plus a ``router`` source) with an `obs.tower.ControlTower` —
+  dead generations fold into a per-slot retired ledger (the cache
+  fabric's ``drop_view`` discipline) so fleet totals NEVER regress on
+  failover, and `validate_fleet_telemetry_artifact` proves the
+  cross-process sums. REQUEST frames carry trace context (router span
+  id + pid); workers publish their own Chrome timelines atomically and
+  the parent merges them onto one clock (`obs.report.merge_traces`)
+  using per-worker offsets estimated from the HELLO exchange. Each
+  worker also keeps a **black box**: its flight-recorder ring is
+  continuously appended to a per-generation JSONL with an atomically
+  published index, and on worker death the supervisor exhumes the dead
+  worker's ring and folds its tail into the parent's post-mortem — a
+  SIGKILL victim still tells its own side of the story.
+
 ``bench.py --procfleet`` is the headline drill: a real mid-burst
 ``SIGKILL -9``, zero lost requests, bit-identity to per-request
 compute, the full lease→breaker→failover→half-open→closed cycle in the
 artifact, and a second kill landed *while the victim holds an L2 read*
 (the ``CONTROL`` dwell knob) to prove no torn row is observable
-cross-process. See docs/serving.md "Process fleet".
+cross-process. See docs/serving.md "Process fleet" and
+docs/observability.md "Distributed observability".
 """
 
 from __future__ import annotations
@@ -70,6 +89,7 @@ import numpy as np
 from ..obs import metrics as _metrics
 from ..obs import recorder as _recorder
 from ..obs import trace as _trace
+from ..obs.tower import SLO
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.faults import fault_point as _fault_point
 from ..resilience.retry import backoff_delay, retry_transient
@@ -83,7 +103,13 @@ from .queue import (
     SubgridRequest,
 )
 
-__all__ = ["ProcessFleet", "SharedSpillReader", "make_worker_spec"]
+__all__ = [
+    "ProcessFleet",
+    "SharedSpillReader",
+    "blackbox_index_path",
+    "exhume_blackbox",
+    "make_worker_spec",
+]
 
 log = logging.getLogger("swiftly-tpu.procfleet")
 
@@ -159,6 +185,7 @@ class SharedSpillReader:
         self._export_version = int(manifest.get("stream_version", 0))
         self.dwell_s = 0.0
         self.dwell_flag_path = dwell_flag_path
+        self.flush_hook = None  # black-box sync point before the flag
         self.rows_read = 0
 
     def _state(self):
@@ -195,6 +222,14 @@ class SharedSpillReader:
             mm = np.load(self._entries[k], mmap_mode="r")
             if self.dwell_s > 0:
                 # hold the mapped read open: the drill's kill window
+                _recorder.record("proc", "proc.l2_dwell",
+                                 f"entry={k} dwell_s={self.dwell_s}")
+                if self.flush_hook is not None:
+                    # persist the dwell event BEFORE announcing the
+                    # window — the SIGKILL that the flag invites lands
+                    # faster than the next heartbeat-cadence flush, and
+                    # the exhumed black box must show the dwell
+                    self.flush_hook()
                 if self.dwell_flag_path:
                     with open(self.dwell_flag_path, "w") as fh:
                         fh.write(str(os.getpid()))
@@ -219,6 +254,156 @@ def write_stream_state(path, *, stream_version, complete=True,
                    "complete": bool(complete),
                    "patching": bool(patching)}, fh)
     os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Black-box recorder: a worker's flight-recorder ring, persisted
+# continuously so a SIGKILL victim still tells its own story
+# ---------------------------------------------------------------------------
+
+
+def blackbox_index_path(run_dir, rid):
+    """The atomically published black-box index for one worker slot."""
+    return os.path.join(run_dir, f"blackbox-{rid}.idx.json")
+
+
+def _blackbox_events_file(rid, generation):
+    return f"blackbox-{rid}.g{generation}.jsonl"
+
+
+class _WorkerBlackBox:
+    """Worker-side black-box flusher: continuously persists the
+    flight-recorder ring so the story survives ``SIGKILL -9``.
+
+    Two-file discipline, mirroring `write_stream_state`:
+
+    * the per-generation events file (``blackbox-<rid>.g<G>.jsonl``)
+      is append-only — each flush drains
+      `obs.recorder.FlightRecorder.events_since` and appends one JSON
+      line per event. A kill mid-write leaves at most one torn trailing
+      line, which `exhume_blackbox` skips;
+    * the index (``blackbox-<rid>.idx.json``) is published atomically
+      (tmp sibling + rename) naming the current generation, events file
+      and count — an exhumer can never read a torn index, only the
+      previously published one.
+    """
+
+    def __init__(self, run_dir, rid, generation, recorder):
+        self.run_dir = run_dir
+        self.rid = int(rid)
+        self.generation = int(generation)
+        self.recorder = recorder
+        self.events_file = _blackbox_events_file(rid, generation)
+        self.n_events = 0
+        self._watermark = -1.0
+        self._published = -1
+        self._lock = threading.Lock()  # heartbeat loop vs dwell hook
+        self._fh = open(os.path.join(run_dir, self.events_file), "a")
+
+    def flush(self):
+        """Append everything the ring recorded since the last flush,
+        then republish the index if the count moved."""
+        with self._lock:
+            evs, self._watermark = self.recorder.events_since(
+                self._watermark)
+            if evs:
+                for e in evs:
+                    self._fh.write(json.dumps(e) + "\n")
+                self._fh.flush()
+                self.n_events += len(evs)
+            if self.n_events != self._published:
+                self._publish_index()
+            return len(evs)
+
+    def _publish_index(self):
+        path = blackbox_index_path(self.run_dir, self.rid)
+        tmp = f"{path}.tmp{self.generation}"
+        with open(tmp, "w") as fh:
+            json.dump({"rid": self.rid, "generation": self.generation,
+                       "events_file": self.events_file,
+                       "n_events": self.n_events,
+                       "t_epoch": time.time()}, fh)
+        os.replace(tmp, path)
+        self._published = self.n_events
+
+    def close(self):
+        try:
+            self.flush()
+        except Exception:
+            pass
+        try:
+            self._fh.close()
+        except Exception:
+            pass
+
+
+def _read_jsonl_tolerant(path):
+    """Events from one black-box JSONL, or None if unreadable. A torn
+    trailing line — the write the kill interrupted — ends the replay
+    instead of raising: everything before it is intact by append-order."""
+    try:
+        with open(path) as fh:
+            raw = fh.read()
+    except OSError:
+        return None
+    events = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            e = json.loads(line)
+        except ValueError:
+            break  # torn tail: stop at the interrupted write
+        if isinstance(e, dict):
+            events.append(e)
+    return events
+
+
+def exhume_blackbox(run_dir, rid, max_generation=None):
+    """Exhume a dead worker's black box: read the atomically published
+    index, then replay the events file it names.
+
+    A torn or missing index falls back to scanning per-generation
+    events files downward from ``max_generation`` — the last
+    generation that managed to persist anything still tells its story.
+    Returns ``{rid, generation, n_events, events, t_epoch,
+    torn_index}`` or None when the worker left nothing readable."""
+    idx = None
+    torn_index = False
+    try:
+        with open(blackbox_index_path(run_dir, rid)) as fh:
+            idx = json.load(fh)
+    except ValueError:
+        torn_index = True
+    except OSError:
+        pass
+    from_index = isinstance(idx, dict) and idx.get("events_file")
+    if from_index:
+        candidates = [(int(idx.get("generation", 0)),
+                       os.path.join(run_dir, idx["events_file"]))]
+    else:
+        top = int(max_generation) if max_generation else 8
+        candidates = [
+            (g, os.path.join(run_dir, _blackbox_events_file(rid, g)))
+            for g in range(top, 0, -1)
+        ]
+    for generation, path in candidates:
+        events = _read_jsonl_tolerant(path)
+        if events is None:
+            continue
+        if not events and not from_index:
+            continue  # empty fallback candidate: try the older one
+        return {
+            "rid": int(rid),
+            "generation": int(generation),
+            "n_events": len(events),
+            "events": events,
+            "t_epoch": (idx or {}).get("t_epoch")
+            if isinstance(idx, dict) else None,
+            "torn_index": torn_index,
+        }
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -296,10 +481,14 @@ def _result_payload(req_id, res):
     }
 
 
-def _worker_main(run_dir, rid, sock_path):
+def _worker_main(run_dir, rid, sock_path, generation=1):
     """Worker process entry: serve REQUEST frames over one unix socket,
     heartbeat every lease interval, drain on DRAIN. Runs until the
-    parent drains it, the parent's socket dies, or it is killed."""
+    parent drains it, the parent's socket dies, or it is killed.
+
+    Observability boots with the worker: metrics + flight recorder are
+    always on (telemetry frames and the black box need them), the
+    tracer when the spec asks (``spec["trace"]``)."""
     logging.basicConfig(
         level=os.environ.get("BENCH_LOGLEVEL", "WARNING"),
         format=f"%(asctime)s worker-{rid}: %(message)s",
@@ -310,7 +499,18 @@ def _worker_main(run_dir, rid, sock_path):
     with open(os.path.join(run_dir, _SPEC_FILE), "rb") as fh:
         spec = pickle.load(fh)
 
+    _metrics.enable()
+    _recorder.enable()
+    tracing = bool(spec.get("trace"))
+    if tracing:
+        _trace.enable()
+    trace_path = os.path.join(run_dir, f"trace-{rid}.g{generation}.json")
+    blackbox = _WorkerBlackBox(run_dir, rid, generation,
+                               _recorder.get_recorder())
+
     service, reader = _worker_serving_stack(spec, run_dir, rid)
+    if reader is not None:
+        reader.flush_hook = blackbox.flush
 
     lsock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     try:
@@ -326,11 +526,29 @@ def _worker_main(run_dir, rid, sock_path):
     stream = ipc.FrameStream(conn)
     hb_interval = float(spec["lease_interval_s"])
     pending = {}  # parent req_id -> SubgridRequest
+    pending_trace = {}  # parent req_id -> (trace ctx, t_accept)
     served = 0
     beats = 0
     last_hb = 0.0
+    last_trace_pub = 0.0
     running = True
     frame_deadline = max(1.0, 4 * hb_interval)
+
+    def telemetry_snapshot():
+        snap = _metrics.export()
+        return {
+            "rid": rid, "pid": os.getpid(), "generation": generation,
+            "t_epoch": time.time(), "beats": beats, "served": served,
+            "pending": len(pending),
+            "counters": dict(snap.get("counters") or {}),
+            "stages": {
+                name: {"count": st.get("count", 0),
+                       "total_s": st.get("total_s", 0.0)}
+                for name, st in (snap.get("stages") or {}).items()
+                if isinstance(st, dict)
+            },
+        }
+
     try:
         while running:
             now = time.monotonic()
@@ -342,6 +560,15 @@ def _worker_main(run_dir, rid, sock_path):
                      "pending": len(pending)},
                     deadline_s=frame_deadline)
                 last_hb = now
+                # the observability plane rides the heartbeat cadence:
+                # persist the ring, ship the cumulative snapshot
+                blackbox.flush()
+                ipc.send_frame(conn, ipc.FRAME_TELEMETRY,
+                               telemetry_snapshot(),
+                               deadline_s=frame_deadline)
+                if tracing and now - last_trace_pub >= 0.5:
+                    _trace.save(trace_path, atomic=True)
+                    last_trace_pub = now
             for req_id in list(pending):
                 freq = pending[req_id]
                 if freq.done:
@@ -351,6 +578,18 @@ def _worker_main(run_dir, rid, sock_path):
                         _result_payload(req_id, freq.result),
                         deadline_s=frame_deadline)
                     served += 1
+                    ctx, t_req = pending_trace.pop(req_id, (None, None))
+                    if ctx and tracing:
+                        # the worker half of the cross-process hop:
+                        # xparent/xpid let merge_traces re-parent this
+                        # span under the router's proc.request
+                        _trace.add_span(
+                            "proc.worker_request", t_req,
+                            time.perf_counter(), cat="proc",
+                            req_id=req_id, rid=rid,
+                            status=freq.result.status,
+                            xparent=ctx.get("span"),
+                            xpid=ctx.get("pid"))
             try:
                 ftype, _flags, obj = stream.recv_frame(
                     deadline_s=min(0.005, hb_interval / 4))
@@ -369,15 +608,23 @@ def _worker_main(run_dir, rid, sock_path):
                     pass
                 break
             if ftype == ipc.FRAME_REQUEST:
+                _recorder.record("proc", "proc.request",
+                                 f"req_id={obj['req_id']}")
                 freq = service.submit(
                     obj["config"], priority=obj.get("priority", 0),
                     deadline_s=obj.get("deadline_s"))
                 pending[obj["req_id"]] = freq
+                pending_trace[obj["req_id"]] = (
+                    obj.get("trace"), time.perf_counter())
             elif ftype == ipc.FRAME_HELLO:
                 ipc.send_frame(
                     conn, ipc.FRAME_HELLO,
                     {"rid": rid, "pid": os.getpid(),
-                     "wire_version": ipc.WIRE_VERSION},
+                     "wire_version": ipc.WIRE_VERSION,
+                     "generation": generation,
+                     # the wall-clock stamp the parent's NTP-style
+                     # offset estimate anchors on (±rtt/2 uncertainty)
+                     "t_epoch": time.time()},
                     deadline_s=frame_deadline)
             elif ftype == ipc.FRAME_CONTROL:
                 if reader is not None and "dwell_l2_s" in obj:
@@ -403,6 +650,12 @@ def _worker_main(run_dir, rid, sock_path):
             service.stop(drain=False)
         except Exception:
             pass
+        blackbox.close()
+        if tracing:
+            try:
+                _trace.save(trace_path, atomic=True)
+            except Exception:
+                pass
         for path in (sock_path, os.path.join(run_dir, f"worker-{rid}.pid")):
             try:
                 os.unlink(path)
@@ -442,6 +695,17 @@ class _Worker:
         self.last_stats = None
         self.hello = None
         self.drained = False
+        # distributed observability plane
+        self.last_beat_t = None      # monotonic time of the last beat
+        self.ready_since = None      # start of the current live span
+        self.live_s = 0.0            # completed live spans (dead gens)
+        self.telemetry = None        # latest live TELEMETRY snapshot
+        self.telemetry_t = None
+        self.telemetry_frames = 0
+        self.telemetry_covered_s = 0.0
+        self.clock_offset = None     # latest generation's estimate
+        self.clock_offsets = {}      # generation -> estimate (history)
+        self.blackbox = None         # last exhumed black-box bundle
 
     @property
     def pid(self):
@@ -451,7 +715,8 @@ class _Worker:
 class _Entry:
     """Parent ledger row: one submitted request until terminal."""
 
-    __slots__ = ("freq", "rid", "reroutes", "not_before", "failover")
+    __slots__ = ("freq", "rid", "reroutes", "not_before", "failover",
+                 "trace_ctx")
 
     def __init__(self, freq):
         self.freq = freq
@@ -459,6 +724,7 @@ class _Entry:
         self.reroutes = 0
         self.not_before = 0.0
         self.failover = False
+        self.trace_ctx = None
 
 
 class ProcessFleet:
@@ -488,9 +754,11 @@ class ProcessFleet:
                  restart_backoff_s=0.1, restart_backoff_max_s=2.0,
                  max_restarts=5, auto_restart=True,
                  request_deadline_s=None, boot_deadline_s=120.0,
-                 frame_deadline_s=2.0):
+                 frame_deadline_s=2.0, worker_trace=False):
         self.spec = dict(spec)
         self.spec["lease_interval_s"] = float(lease_interval_s)
+        self.spec["trace"] = bool(worker_trace)
+        self.worker_trace = bool(worker_trace)
         self.n_workers = int(n_workers)
         self.stream_spill = stream_spill
         self.run_root = run_root or fleet_run_root()
@@ -524,8 +792,15 @@ class ProcessFleet:
             "failed": 0, "completed": 0, "failovers": 0, "reroutes": 0,
             "worker_deaths": 0, "restarts": 0, "orphans_reaped": 0,
             "stale_sockets_swept": 0, "heartbeats": 0,
+            "telemetry_frames": 0, "telemetry_zombie": 0,
+            "blackbox_exhumed": 0,
         }
         self._episodes = []  # [{"t0", "done", "failovers"}]
+        self._tower = None
+        # per-slot retired telemetry ledger: dead generations' final
+        # counters/stages fold here (the cache fabric's drop_view
+        # discipline) so fleet totals never regress on failover
+        self._retired = {}
 
     # -- startup hygiene ----------------------------------------------------
 
@@ -627,7 +902,7 @@ class ProcessFleet:
         w.proc = subprocess.Popen(
             [sys.executable, "-m", WORKER_MARKER, "--worker",
              "--run-dir", self.run_dir, "--rid", str(w.rid),
-             "--sock", w.sock_path],
+             "--sock", w.sock_path, "--generation", str(w.generation)],
             stdout=logf, stderr=subprocess.STDOUT, env=env,
             cwd=os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))),
@@ -661,10 +936,12 @@ class ProcessFleet:
                 return
             w.sock = sock
             w.wsock = sock.dup()
+        t_hello = time.time()
         try:
             with w.send_lock:
                 ipc.send_frame(w.wsock, ipc.FRAME_HELLO,
-                               {"fleet_pid": os.getpid()},
+                               {"fleet_pid": os.getpid(),
+                                "t_epoch": t_hello},
                                deadline_s=self.frame_deadline_s)
         except ipc.WireError:
             pass
@@ -681,8 +958,10 @@ class ProcessFleet:
                 self._on_heartbeat(w, generation, obj, now)
             elif ftype == ipc.FRAME_RESULT:
                 self._on_result(w, obj, now)
+            elif ftype == ipc.FRAME_TELEMETRY:
+                self._on_telemetry(w, generation, obj, now)
             elif ftype == ipc.FRAME_HELLO:
-                w.hello = obj
+                self._on_hello(w, generation, obj, t_hello, time.time())
             elif ftype == ipc.FRAME_DRAIN:
                 w.drained = True
             elif ftype == ipc.FRAME_ERROR:
@@ -700,8 +979,10 @@ class ProcessFleet:
         with self._lock:
             if w.generation != generation:
                 return
+            w.last_beat_t = now
             if not w.ready:
                 w.ready = True
+                w.ready_since = now
                 if w.lease is None:
                     w.lease = HealthLease(
                         f"worker-{w.rid}", self.lease_interval_s,
@@ -713,6 +994,79 @@ class ProcessFleet:
                 elif w.lease.revoked:
                     self._monitor.revive(w.rid)
         w.lease.beat(now)
+
+    @staticmethod
+    def _clock_offset_from_hello(t_send, t_recv, hello):
+        """NTP-style one-exchange offset estimate: the worker stamped
+        its wall clock (``t_epoch``) somewhere inside the HELLO round
+        trip, so assuming the midpoint, the worker's clock runs
+        ``t_worker - (t_send + rtt/2)`` ahead of ours. Correct within
+        ±rtt/2 — which is exactly why the RTT is recorded next to the
+        offset and carried into the merged-trace manifest."""
+        t_worker = (hello or {}).get("t_epoch")
+        if not isinstance(t_worker, (int, float)):
+            return None
+        rtt = max(0.0, float(t_recv) - float(t_send))
+        return {"offset_s": float(t_worker) - (float(t_send) + rtt / 2.0),
+                "rtt_s": rtt}
+
+    def _on_hello(self, w, generation, obj, t_send, t_recv):
+        with self._lock:
+            if w.generation != generation:
+                return
+            w.hello = obj
+            off = self._clock_offset_from_hello(t_send, t_recv, obj)
+            if off is not None:
+                off["pid"] = (obj or {}).get("pid")
+                off["generation"] = generation
+                w.clock_offset = off
+                w.clock_offsets[generation] = off
+
+    def _on_telemetry(self, w, generation, obj, now):
+        self.counts["telemetry_frames"] += 1
+        _metrics.count("proc.telemetry_frames")
+        with self._lock:
+            if (not isinstance(obj, dict)
+                    or w.generation != generation
+                    or obj.get("generation", generation) != generation):
+                # a zombie generation's snapshot (or garbage): counted,
+                # never folded into the live slot's telemetry
+                self.counts["telemetry_zombie"] += 1
+                _metrics.count("proc.telemetry_zombie")
+                return
+            w.telemetry_frames += 1
+            if w.telemetry_t is not None:
+                # coverage accrual: the wall this frame vouches for,
+                # capped so a stalled worker's late frame cannot claim
+                # the stall as observed time
+                gap = max(0.0, now - w.telemetry_t)
+                w.telemetry_covered_s += min(
+                    gap, 4 * self.lease_interval_s)
+            w.telemetry = obj
+            w.telemetry_t = now
+
+    def _retire_telemetry(self, w):
+        """Fold the dead generation's final telemetry snapshot into the
+        per-slot retired ledger — the cache fabric's ``drop_view``
+        discipline: a worker's counters outlive its process, so the
+        fleet totals the tower sums NEVER regress on failover."""
+        snap, w.telemetry = w.telemetry, None
+        w.telemetry_t = None
+        if not isinstance(snap, dict):
+            return
+        led = self._retired.setdefault(
+            w.rid, {"counters": {}, "stages": {}, "generations": 0})
+        led["generations"] += 1
+        for name, v in (snap.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                led["counters"][name] = led["counters"].get(name, 0) + v
+        for name, st in (snap.get("stages") or {}).items():
+            if not isinstance(st, dict):
+                continue
+            agg = led["stages"].setdefault(
+                name, {"count": 0, "total_s": 0.0})
+            agg["count"] += int(st.get("count", 0) or 0)
+            agg["total_s"] += float(st.get("total_s", 0.0) or 0.0)
 
     def _on_result(self, w, obj, now):
         req_id = obj["req_id"]
@@ -766,6 +1120,17 @@ class ProcessFleet:
                 self.counts["failed"] += 1
             if entry.failover and self._episodes:
                 self._episodes[-1]["done"] = now
+        if entry.trace_ctx is not None and _trace.enabled():
+            # the router half of the cross-process request: duration-
+            # derived endpoints keep this clock-safe even where
+            # monotonic and perf_counter differ
+            t1 = time.perf_counter()
+            dur = max(0.0, now - entry.freq.submit_t)
+            _trace.add_span(
+                "proc.request", t1 - dur, t1, cat="proc",
+                parent=entry.trace_ctx.get("span") or 0,
+                req_id=entry.freq.req_id, rid=entry.rid,
+                status=res.status, failover=entry.failover)
         entry.freq._complete(res)
 
     # -- routing ------------------------------------------------------------
@@ -810,6 +1175,13 @@ class ProcessFleet:
         freq = SubgridRequest(config, priority=priority,
                               deadline_s=deadline_s)
         entry = _Entry(freq)
+        if self.worker_trace and _trace.enabled():
+            # the cross-process trace context REQUEST frames carry:
+            # the router's current span + pid let the worker stamp
+            # xparent/xpid, which merge_traces re-parents across the hop
+            entry.trace_ctx = {"id": freq.req_id,
+                               "span": _trace.current(),
+                               "pid": os.getpid()}
         with self._lock:
             self._pending[freq.req_id] = entry
             self.counts["requests"] += 1
@@ -836,6 +1208,7 @@ class ProcessFleet:
             "config": entry.freq.config,
             "priority": entry.freq.priority,
             "deadline_s": remaining,
+            "trace": entry.trace_ctx,
         }
         with self._lock:
             # claim BEFORE sending so the supervisor's scan can never
@@ -885,6 +1258,8 @@ class ProcessFleet:
                         self._on_revoked(rid, now)
                 self._scan(now)
                 self._restart_due(now)
+                if self._tower is not None:
+                    self._tower.tick(now)
             except Exception:  # pragma: no cover - supervisor must live
                 log.exception("supervisor tick failed")
 
@@ -913,6 +1288,12 @@ class ProcessFleet:
             except Exception:
                 pass
         self._drop_connection(w)
+        with self._lock:
+            if w.ready_since is not None:
+                w.live_s += max(0.0, now - w.ready_since)
+                w.ready_since = None
+            self._retire_telemetry(w)
+        self._exhume_worker(w)
         # fail the dead worker's in-flight ledger rows over
         failovers = 0
         with self._lock:
@@ -932,6 +1313,38 @@ class ProcessFleet:
             w.restart_at = now + backoff_delay(
                 w.restarts, base_s=self.restart_backoff_s,
                 max_s=self.restart_backoff_max_s)
+
+    def _exhume_worker(self, w):
+        """Dig up the dead worker's black box and fold its event tail
+        into the PARENT's flight recorder: the next post-mortem shows
+        what the victim itself saw in its last seconds — the L2 dwell
+        it held, the request it was serving — not just the router's
+        outside view of the silence."""
+        try:
+            box = exhume_blackbox(self.run_dir, w.rid,
+                                  max_generation=w.generation)
+        except Exception:  # pragma: no cover - exhumation best-effort
+            log.exception("black-box exhumation failed for rid %d",
+                          w.rid)
+            return
+        if box is None:
+            return
+        w.blackbox = box
+        self.counts["blackbox_exhumed"] += 1
+        _metrics.count("proc.blackbox_exhumed")
+        _recorder.record(
+            "proc", "proc.blackbox_exhumed",
+            f"rid={w.rid} g={box['generation']} "
+            f"events={box['n_events']}"
+            + (" torn_index" if box.get("torn_index") else ""))
+        tail = [e for e in box["events"]
+                if isinstance(e, dict) and e.get("kind") != "stage"][-32:]
+        for e in tail:
+            detail = e.get("detail")
+            _recorder.record(
+                e.get("kind", "proc"), str(e.get("name", "?")),
+                f"[worker-{w.rid} g{box['generation']} t={e.get('t')}]"
+                + ("" if detail is None else f" {detail}"))
 
     def _scan(self, now):
         with self._lock:
@@ -970,6 +1383,180 @@ class ProcessFleet:
                 # trips persist: the restarted worker re-earns trust
                 # through the breaker's half-open probe path
                 self._spawn(w, now)
+
+    # -- distributed observability plane ------------------------------------
+
+    def register_tower(self, tower, *, slos=True, queue_depth_limit=None,
+                       failover_budget_ms=1000.0):
+        """Plug the fleet into an `obs.tower.ControlTower`: one
+        ``router`` source (the parent's ledger counters), one
+        ``worker-<rid>`` source per slot (live TELEMETRY snapshot +
+        the retired ledger, so totals survive failover), the fleet
+        signals (``proc.heartbeat_gap_s``, ``proc.queue_depth``,
+        ``proc.failover_ms``) and — unless ``slos=False`` — the
+        matching burn-rate SLOs (``proc_heartbeat_gap``,
+        ``proc_queue_depth``, ``proc_failover``). The supervisor ticks
+        the tower once registered, so sampling shares the fleet's
+        supervision clock."""
+        self._tower = tower
+        tower.register_source("router", self._router_source,
+                              kind="router")
+        for rid in range(self.n_workers):
+            tower.register_source(
+                f"worker-{rid}",
+                (lambda r=rid: self._worker_source(r)),
+                kind="worker")
+        tower.register_signal("proc.heartbeat_gap_s",
+                              self._signal_heartbeat_gap)
+        tower.register_signal(
+            "proc.queue_depth", lambda: float(len(self._pending)))
+        tower.register_signal("proc.failover_ms",
+                              self._signal_failover_ms)
+        if slos:
+            fast = max(0.2, 10 * self.lease_interval_s)
+            slow = 3 * fast
+            if queue_depth_limit is None:
+                queue_depth_limit = 8 * self.n_workers
+            tower.add_slo(SLO(
+                "proc_heartbeat_gap", "proc.heartbeat_gap_s",
+                threshold=self.miss_revoke * self.lease_interval_s,
+                direction="above", fast_s=fast, slow_s=slow, burn=0.5))
+            tower.add_slo(SLO(
+                "proc_queue_depth", "proc.queue_depth",
+                threshold=float(queue_depth_limit),
+                direction="above", fast_s=fast, slow_s=slow, burn=0.5))
+            tower.add_slo(SLO(
+                "proc_failover", "proc.failover_ms",
+                threshold=float(failover_budget_ms),
+                direction="above", fast_s=fast, slow_s=slow, burn=0.5))
+        return tower
+
+    def _router_source(self):
+        """The parent's own telemetry source: ledger counters under a
+        ``proc.router.`` prefix so they never collide with the workers'
+        in-process ``proc.*`` metric names."""
+        with self._lock:
+            counters = {f"proc.router.{k}": v
+                        for k, v in self.counts.items()}
+        return {"counters": counters, "pid": os.getpid()}
+
+    def _worker_source(self, rid):
+        """One slot's telemetry source: the retired ledger (every dead
+        generation's final snapshot) plus the live generation's latest
+        TELEMETRY frame — monotone across restarts by construction."""
+        w = self._workers.get(rid)
+        with self._lock:
+            led = self._retired.get(rid) or {}
+            counters = dict(led.get("counters") or {})
+            stages = {name: dict(st)
+                      for name, st in (led.get("stages") or {}).items()}
+            snap = w.telemetry if w is not None else None
+            if isinstance(snap, dict):
+                for name, v in (snap.get("counters") or {}).items():
+                    if isinstance(v, (int, float)):
+                        counters[name] = counters.get(name, 0) + v
+                for name, st in (snap.get("stages") or {}).items():
+                    if not isinstance(st, dict):
+                        continue
+                    agg = stages.setdefault(
+                        name, {"count": 0, "total_s": 0.0})
+                    agg["count"] += int(st.get("count", 0) or 0)
+                    agg["total_s"] += float(st.get("total_s", 0.0) or 0.0)
+        return {
+            "counters": counters,
+            "stages": stages,
+            "pid": w.pid if w is not None else None,
+            "generation": w.generation if w is not None else 0,
+            "alive": bool(w is not None and not w.dead),
+            "retired_generations": int(led.get("generations", 0)),
+            "telemetry_frames": w.telemetry_frames if w is not None
+            else 0,
+            "last_stats": w.last_stats if w is not None else None,
+        }
+
+    def _signal_heartbeat_gap(self):
+        """Seconds since the quietest live worker's last heartbeat —
+        the wire-level liveness signal the SLO watches."""
+        now = time.monotonic()
+        with self._lock:
+            gaps = [now - w.last_beat_t for w in self._workers.values()
+                    if not w.dead and w.last_beat_t is not None]
+        return max(gaps) if gaps else 0.0
+
+    def _signal_failover_ms(self):
+        """The latest COMPLETED failover episode's duration (0 with
+        none yet) — burns the ``proc_failover`` SLO when recovery
+        blows its budget."""
+        with self._lock:
+            for ep in reversed(self._episodes):
+                if ep["done"] is not None and ep["failovers"]:
+                    return (ep["done"] - ep["t0"]) * 1e3
+        return 0.0
+
+    def telemetry_coverage(self, now=None):
+        """Fraction of worker live-seconds vouched for by TELEMETRY
+        frames (clamped to [0, 1]; None before any worker went live).
+        The ``procfleet.telemetry_coverage`` bench sentinel: a wire
+        regression that drops frames shows up here before anyone
+        misses the data."""
+        now = time.monotonic() if now is None else now
+        covered = 0.0
+        live = 0.0
+        with self._lock:
+            for w in self._workers.values():
+                covered += w.telemetry_covered_s
+                live += w.live_s
+                if w.ready_since is not None and not w.dead:
+                    live += max(0.0, now - w.ready_since)
+        if live <= 0.0:
+            return None
+        return max(0.0, min(1.0, covered / live))
+
+    def merged_trace(self, labels=None):
+        """ONE Perfetto timeline for the whole fleet: the router's own
+        trace as the time base, every worker generation's atomically
+        published timeline shifted onto it using the HELLO clock
+        offsets (`obs.report.merge_traces`). Call BEFORE `stop()` —
+        workers publish into the run dir, which stop() removes."""
+        from ..obs.report import merge_traces
+
+        traces = [_trace.export()]
+        offsets = {}
+        label_map = {os.getpid(): "router"}
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            for g, off in sorted(w.clock_offsets.items()):
+                pid = off.get("pid")
+                if pid is not None:
+                    offsets[pid] = off
+                    label_map.setdefault(pid, f"worker-{w.rid}.g{g}")
+            for g in range(1, w.generation + 1):
+                path = os.path.join(self.run_dir,
+                                    f"trace-{w.rid}.g{g}.json")
+                try:
+                    with open(path) as fh:
+                        traces.append(json.load(fh))
+                except (OSError, ValueError):
+                    continue
+        if labels:
+            label_map.update(labels)
+        return merge_traces(traces, offsets=offsets, labels=label_map)
+
+    def heartbeat_fields(self):
+        """The fleet fields `obs.heartbeat.Heartbeat` stamps when a
+        ProcessFleet rides along on a beat: live worker count, summed
+        worker generations, open tower alerts (None without a tower)."""
+        with self._lock:
+            alive = sum(1 for w in self._workers.values() if not w.dead)
+            gens = sum(w.generation for w in self._workers.values())
+        return {
+            "proc_workers": alive,
+            "worker_generations": gens,
+            "proc_open_alerts": (
+                len(self._tower.open_alerts())
+                if self._tower is not None else None),
+        }
 
     # -- drill / operator surface -------------------------------------------
 
@@ -1105,6 +1692,29 @@ class ProcessFleet:
             "breakers": {
                 w.rid: w.breaker.stats() for w in self._workers.values()
             },
+            "telemetry": {
+                "frames": self.counts["telemetry_frames"],
+                "zombie_frames": self.counts["telemetry_zombie"],
+                "coverage": self.telemetry_coverage(),
+                "retired_generations": sum(
+                    led.get("generations", 0)
+                    for led in self._retired.values()),
+            },
+            "clock_offsets": {
+                str(w.rid): dict(w.clock_offset)
+                for w in self._workers.values()
+                if w.clock_offset is not None
+            },
+            "black_box": {
+                "exhumed": [
+                    {"rid": w.rid,
+                     "generation": w.blackbox["generation"],
+                     "n_events": w.blackbox["n_events"],
+                     "torn_index": bool(w.blackbox.get("torn_index"))}
+                    for w in self._workers.values()
+                    if w.blackbox is not None
+                ],
+            },
             "per_worker": [
                 {
                     "id": w.rid,
@@ -1114,6 +1724,9 @@ class ProcessFleet:
                     "restarts": w.restarts,
                     "served": w.served,
                     "heartbeats": w.heartbeats,
+                    "telemetry_frames": w.telemetry_frames,
+                    "clock_offset": w.clock_offset,
+                    "last_stats": w.last_stats,
                     "qps": (w.served / wall_s) if wall_s else None,
                 }
                 for w in self._workers.values()
@@ -1152,8 +1765,10 @@ def main(argv=None):
     parser.add_argument("--run-dir", required=True)
     parser.add_argument("--rid", type=int, required=True)
     parser.add_argument("--sock", required=True)
+    parser.add_argument("--generation", type=int, default=1)
     args = parser.parse_args(argv)
-    return _worker_main(args.run_dir, args.rid, args.sock)
+    return _worker_main(args.run_dir, args.rid, args.sock,
+                        generation=args.generation)
 
 
 if __name__ == "__main__":  # pragma: no cover - subprocess entry

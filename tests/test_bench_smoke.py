@@ -569,6 +569,51 @@ def test_compare_procfleet_sentinels_synthetic(tmp_path):
     assert compare_main(args) == 0
 
 
+def test_compare_telemetry_coverage_sentinel_synthetic(tmp_path):
+    """The `procfleet.telemetry_coverage` sentinel in
+    scripts/bench_compare.py, exercised in tier-1 on synthetic records:
+    identical records stay green, a coverage that falls more than the
+    threshold below the best same-leg reference trips (TELEMETRY frames
+    stopped covering the workers' live time), a dip inside the
+    threshold stays green, and an improving run never trips."""
+    sys.path.insert(0, str(REPO))
+    from scripts.bench_compare import main as compare_main
+
+    def rec(coverage=0.92):
+        return {
+            "metric": "procfleet drill wall-clock",
+            "value": 4.0,
+            "manifest": {
+                "config_params": {
+                    "config": "1k[1]-n512-256", "mode": "procfleet",
+                },
+                "device": {"platform": "cpu"},
+            },
+            "procfleet": {
+                "failover_ms": 14.0,
+                "lost_requests": 0,
+                "telemetry": {"frames": 40, "coverage": coverage},
+            },
+        }
+
+    latest = tmp_path / "latest.json"
+    ref = tmp_path / "ref.json"
+    args = [str(latest), "--against", str(ref), "--json"]
+    ref.write_text(json.dumps(rec()))
+    latest.write_text(json.dumps(rec()))
+    assert compare_main(args) == 0
+    # coverage collapsed >20% below the best reference -> trip
+    latest.write_text(json.dumps(rec(coverage=0.5)))
+    assert compare_main(args) == 1
+    # a dip inside the threshold -> green
+    latest.write_text(json.dumps(rec(coverage=0.85)))
+    assert compare_main(args) == 0
+    # improving over a weak reference -> green
+    latest.write_text(json.dumps(rec(coverage=0.99)))
+    ref.write_text(json.dumps(rec(coverage=0.5)))
+    assert compare_main(args) == 0
+
+
 def test_compare_fabric_sentinels_synthetic(tmp_path):
     """The `cache.hit_ratio` / `fleet.stream_copies` sentinels in
     scripts/bench_compare.py, exercised in tier-1 on synthetic records

@@ -19,7 +19,22 @@ NOT need a booted fleet, pinned fast and in-process:
   scalar types;
 * SCHEMA — `obs.validate_procfleet_artifact` passes the healthy drill
   shape and trips on every contract break (lost requests, missing
-  mid-L2-kill proof, an unfinished breaker cycle, ...).
+  mid-L2-kill proof, an unfinished breaker cycle, doctored telemetry
+  totals, garbage heartbeat payloads, a one-process "merged" timeline,
+  a black box that never reached the post-mortem, ...);
+* TELEMETRY — `_on_telemetry` folds live TELEMETRY frames per
+  generation, gates zombie-generation snapshots (counted, never
+  folded), and `_retire_telemetry` keeps a dead generation's counters
+  in the per-slot retired ledger so `_worker_source` sums NEVER
+  regress across a failover;
+* BLACK BOX — `_WorkerBlackBox` appends the flight-recorder ring as
+  crash-safe JSONL with an atomically published index;
+  `exhume_blackbox` replays it, skips the one torn trailing line a
+  SIGKILL can leave, and falls back to generation scanning when the
+  index itself is torn;
+* CLOCKS — `_clock_offset_from_hello`'s NTP-style estimate stays
+  within ±rtt/2 of a known injected skew even when the HELLO exchange
+  itself is slowed through the ``proc.spawn`` fault site.
 
 The real multi-process SIGKILL drill lives in test_bench_smoke.py.
 """
@@ -36,10 +51,15 @@ import numpy as np
 import pytest
 
 from swiftly_tpu.obs import validate_procfleet_artifact
+from swiftly_tpu.obs.recorder import FlightRecorder
+from swiftly_tpu.resilience import faults
 from swiftly_tpu.serve import procfleet
 from swiftly_tpu.serve.procfleet import (
     ProcessFleet,
     SharedSpillReader,
+    _WorkerBlackBox,
+    blackbox_index_path,
+    exhume_blackbox,
     make_worker_spec,
     write_stream_state,
 )
@@ -280,8 +300,11 @@ def _healthy_record():
             "failover_ms": 13.5,
             "breaker_cycle": ["open", "half_open", "closed"],
             "per_worker": [
-                {"id": 0, "served": 25, "qps": 6.0},
-                {"id": 1, "served": 23, "qps": 5.5},
+                {"id": 0, "served": 25, "qps": 6.0,
+                 "last_stats": {"beats": 120, "served": 25,
+                                "pending": 0}},
+                {"id": 1, "served": 23, "qps": 5.5,
+                 "last_stats": None},  # never beat: no payload yet
             ],
             "health_transitions": [
                 {"t": 1.0, "owner": 1, "from": "live", "to": "revoked",
@@ -291,6 +314,46 @@ def _healthy_record():
             "mid_l2_kill": {"killed_mid_read": True,
                             "row_bit_identical": True},
             "wire": {"heartbeats": 120},
+            "telemetry": {"frames": 240, "zombie_frames": 1,
+                          "coverage": 0.97,
+                          "retired_generations": 2},
+            "clock_offsets": {
+                "0": {"offset_s": 0.0012, "rtt_s": 0.0004,
+                      "pid": 1001, "generation": 2},
+                "1": {"offset_s": -0.0009, "rtt_s": 0.0003,
+                      "pid": 1002, "generation": 2},
+            },
+            "trace_merge": {"n_processes": 3,
+                            "pids": [1000, 1001, 1002],
+                            "cross_process_requests": 48},
+            "black_box": {
+                "exhumed": [{"rid": 0, "generation": 1,
+                             "n_events": 40, "torn_index": False}],
+                "victim_events_in_post_mortem": True,
+            },
+        },
+        "fleet_telemetry": {
+            "n_sources": 3,
+            "sources": {
+                "router": {"kind": "router",
+                           "counters": {"proc.router.requests": 48}},
+                "worker-0": {
+                    "kind": "worker",
+                    "counters": {"proc.served": 25},
+                    "stages": {"serve.batch": {"count": 5,
+                                               "total_s": 0.5}}},
+                "worker-1": {
+                    "kind": "worker",
+                    "counters": {"proc.served": 23},
+                    "stages": {"serve.batch": {"count": 4,
+                                               "total_s": 0.4}}},
+            },
+            "totals": {
+                "counters": {"proc.router.requests": 48,
+                             "proc.served": 48},
+                "stages": {"serve.batch": {"count": 9,
+                                           "total_s": 0.9}},
+            },
         },
         "manifest": {
             "schema": None,
@@ -333,6 +396,33 @@ def test_validate_procfleet_artifact_healthy():
      "bit-identity audit failed"),
     (lambda r: r.__setitem__("p99_ms", 1.0), "p99_ms"),
     (lambda r: r.pop("procfleet"), "missing procfleet block"),
+    # -- distributed observability plane trips --------------------------
+    (lambda r: r.pop("fleet_telemetry"),
+     "cross-process telemetry plane"),
+    (lambda r: r["fleet_telemetry"]["totals"]["counters"].__setitem__(
+        "proc.served", 47), "per-source sum"),
+    (lambda r: r["procfleet"]["per_worker"][0].__setitem__(
+        "last_stats", "garbage"), "expected a heartbeat dict"),
+    (lambda r: r["procfleet"]["per_worker"][0].__setitem__(
+        "last_stats", {"beats": -1, "served": 25, "pending": 0}),
+     "is not a counter"),
+    (lambda r: r["procfleet"]["telemetry"].__setitem__("frames", 0),
+     "no TELEMETRY frame"),
+    (lambda r: r["procfleet"]["telemetry"].__setitem__(
+        "coverage", 1.5), "not in [0, 1]"),
+    (lambda r: r["procfleet"].__setitem__("clock_offsets", {}),
+     "clock_offsets is empty"),
+    (lambda r: r["procfleet"]["clock_offsets"]["0"].__setitem__(
+        "rtt_s", -0.1), "non-negative uncertainty"),
+    (lambda r: r["procfleet"]["trace_merge"].__setitem__(
+        "n_processes", 1), "not a merged timeline"),
+    (lambda r: r["procfleet"]["trace_merge"].__setitem__(
+        "cross_process_requests", 0), "crossed a process boundary"),
+    (lambda r: r["procfleet"]["black_box"].__setitem__("exhumed", []),
+     "black_box.exhumed is empty"),
+    (lambda r: r["procfleet"]["black_box"].__setitem__(
+        "victim_events_in_post_mortem", False),
+     "never reached the parent's post-mortem"),
 ])
 def test_validate_procfleet_artifact_trips(doctor, needle):
     record = _healthy_record()
@@ -340,3 +430,199 @@ def test_validate_procfleet_artifact_trips(doctor, needle):
     problems = validate_procfleet_artifact(record)
     assert problems, f"doctored record passed: {needle}"
     assert any(needle in p for p in problems), problems
+
+
+# ---------------------------------------------------------------------------
+# wire telemetry: frame folding, zombie gate, retired ledger
+# ---------------------------------------------------------------------------
+
+
+def _bare_fleet(tmp_path, n=2):
+    """A fleet with hand-built worker slots and NO processes — the
+    telemetry fold/retire path needs only the parent-side ledger."""
+    fleet = ProcessFleet(make_worker_spec({}, []), n,
+                         run_root=str(tmp_path / "procfleet"))
+    for rid in range(n):
+        w = procfleet._Worker(rid)
+        w.generation = 1
+        w.dead = False
+        fleet._workers[rid] = w
+    return fleet
+
+
+def _snap(generation, counters, stages=None, **extra):
+    return {"rid": 0, "pid": 4242, "generation": generation,
+            "beats": 10, "served": 5, "pending": 0,
+            "counters": dict(counters), "stages": dict(stages or {}),
+            **extra}
+
+
+def test_on_telemetry_folds_live_generation(tmp_path):
+    fleet = _bare_fleet(tmp_path)
+    w = fleet.worker(0)
+    fleet._on_telemetry(w, w.generation,
+                        _snap(w.generation, {"proc.served": 5}), 1.0)
+    assert fleet.counts["telemetry_frames"] == 1
+    assert fleet.counts["telemetry_zombie"] == 0
+    assert w.telemetry_frames == 1
+    assert w.telemetry["counters"] == {"proc.served": 5}
+    src = fleet._worker_source(0)
+    assert src["counters"]["proc.served"] == 5
+    assert src["alive"] is True
+
+
+def test_on_telemetry_gates_zombie_generation(tmp_path):
+    # a snapshot from a generation the slot no longer runs (or stamped
+    # with the wrong generation) is COUNTED and IGNORED — zombie
+    # frames must never pollute the live slot's telemetry
+    fleet = _bare_fleet(tmp_path)
+    w = fleet.worker(0)
+    w.generation = 2
+    fleet._on_telemetry(w, 1, _snap(1, {"proc.served": 99}), 1.0)
+    fleet._on_telemetry(w, 2, _snap(1, {"proc.served": 99}), 1.0)
+    fleet._on_telemetry(w, 2, "not-a-dict", 1.0)
+    assert fleet.counts["telemetry_frames"] == 3
+    assert fleet.counts["telemetry_zombie"] == 3
+    assert w.telemetry is None and w.telemetry_frames == 0
+    fleet._on_telemetry(w, 2, _snap(2, {"proc.served": 7}), 2.0)
+    assert fleet.counts["telemetry_zombie"] == 3
+    assert fleet._worker_source(0)["counters"]["proc.served"] == 7
+
+
+def test_retired_ledger_keeps_totals_monotone_across_failover(tmp_path):
+    # the drop_view discipline: a dead generation's counters fold into
+    # the retired ledger, so the slot's summed source never regresses
+    # when the restarted generation reports from zero
+    fleet = _bare_fleet(tmp_path)
+    w = fleet.worker(0)
+    fleet._on_telemetry(
+        w, w.generation,
+        _snap(w.generation, {"proc.served": 20},
+              {"serve.batch": {"count": 4, "total_s": 0.4}}), 1.0)
+    before = fleet._worker_source(0)
+    assert before["counters"]["proc.served"] == 20
+    with fleet._lock:
+        fleet._retire_telemetry(w)
+    retired = fleet._worker_source(0)
+    assert retired["counters"]["proc.served"] == 20
+    assert retired["retired_generations"] == 1
+    # the restarted generation starts over; the sum only grows
+    w.generation = 2
+    fleet._on_telemetry(
+        w, 2, _snap(2, {"proc.served": 3},
+                    {"serve.batch": {"count": 1, "total_s": 0.1}}), 2.0)
+    after = fleet._worker_source(0)
+    assert after["counters"]["proc.served"] == 23
+    assert after["stages"]["serve.batch"]["count"] == 5
+    assert abs(after["stages"]["serve.batch"]["total_s"] - 0.5) < 1e-9
+
+
+def test_telemetry_coverage_ratio(tmp_path):
+    fleet = _bare_fleet(tmp_path)
+    w0, w1 = fleet.worker(0), fleet.worker(1)
+    assert fleet.telemetry_coverage(now=0.0) is None  # nothing live yet
+    w0.live_s = 6.0
+    w0.telemetry_covered_s = 5.4
+    w1.live_s = 4.0
+    w1.telemetry_covered_s = 3.6
+    assert abs(fleet.telemetry_coverage(now=0.0) - 0.9) < 1e-9
+    w1.telemetry_covered_s = 100.0  # clamped, never > 1
+    assert fleet.telemetry_coverage(now=0.0) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# black box: crash-safe persistence + exhumation
+# ---------------------------------------------------------------------------
+
+
+def test_blackbox_flush_publishes_ring_and_index(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    box = _WorkerBlackBox(str(tmp_path), 0, 1, rec)
+    rec.record("proc", "proc.request", "req_id=1")
+    rec.record("proc", "proc.l2_dwell", "entry=0 dwell_s=1.5")
+    assert box.flush() == 2
+    assert box.flush() == 0  # watermark: nothing re-emitted
+    rec.record("proc", "proc.request", "req_id=2")
+    assert box.flush() == 1
+    box.close()
+    idx = json.loads(
+        (tmp_path / os.path.basename(
+            blackbox_index_path(str(tmp_path), 0))).read_text())
+    assert idx["generation"] == 1 and idx["n_events"] == 3
+    dug = exhume_blackbox(str(tmp_path), 0)
+    assert dug["n_events"] == 3 and dug["torn_index"] is False
+    assert [e["name"] for e in dug["events"]] == [
+        "proc.request", "proc.l2_dwell", "proc.request"]
+    # no tmp droppings from the atomic index publish
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+
+def test_exhume_skips_torn_trailing_line(tmp_path):
+    rec = FlightRecorder(enabled=True)
+    box = _WorkerBlackBox(str(tmp_path), 0, 1, rec)
+    rec.record("proc", "proc.request", "req_id=1")
+    box.flush()
+    box.close()
+    # the write SIGKILL interrupted: half a JSON line at the tail
+    with open(tmp_path / "blackbox-0.g1.jsonl", "a") as fh:
+        fh.write('{"t": 1.0, "kind": "proc", "na')
+    dug = exhume_blackbox(str(tmp_path), 0)
+    assert dug["n_events"] == 1  # intact prefix only
+    assert dug["events"][0]["name"] == "proc.request"
+
+
+def test_exhume_torn_index_falls_back_to_generation_scan(tmp_path):
+    # generation 2 persisted events, then died mid-index-publish in a
+    # way that left a torn index: exhumation must fall back to the
+    # newest generation file that replays
+    rec = FlightRecorder(enabled=True)
+    box = _WorkerBlackBox(str(tmp_path), 3, 2, rec)
+    rec.record("proc", "proc.worker_death", "rid=3")
+    box.flush()
+    box.close()
+    with open(blackbox_index_path(str(tmp_path), 3), "w") as fh:
+        fh.write('{"rid": 3, "generation"')  # torn index
+    dug = exhume_blackbox(str(tmp_path), 3, max_generation=2)
+    assert dug["torn_index"] is True
+    assert dug["generation"] == 2
+    assert dug["n_events"] == 1
+    assert exhume_blackbox(str(tmp_path), 7) is None  # nothing left
+
+
+# ---------------------------------------------------------------------------
+# clock offsets: NTP-style HELLO estimate
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offset_from_hello_bounds():
+    est = ProcessFleet._clock_offset_from_hello(
+        10.0, 10.004, {"t_epoch": 10.502})
+    assert abs(est["rtt_s"] - 0.004) < 1e-12
+    assert abs(est["offset_s"] - 0.5) < est["rtt_s"] / 2 + 1e-9
+    assert ProcessFleet._clock_offset_from_hello(
+        10.0, 10.004, {"t_epoch": "soon"}) is None
+    assert ProcessFleet._clock_offset_from_hello(10.0, 10.004, None) is None
+
+
+def test_clock_offset_sane_under_injected_hello_latency():
+    # slow the HELLO round trip through the proc.spawn fault site: the
+    # estimate must still land within the +-rtt/2 bound it advertises,
+    # and the recorded rtt must own the injected delay
+    true_skew = 0.25
+    delay = 0.05
+    faults.install(faults.FaultPlan([
+        {"site": "proc.spawn", "kind": "latency", "every": 1,
+         "delay_s": delay},
+    ]))
+    try:
+        t_send = time.time()
+        faults.fault_point("proc.spawn")  # the wire stalls mid-HELLO
+        t_worker = time.time() + true_skew
+        faults.fault_point("proc.spawn")  # ...and again on the reply
+        t_recv = time.time()
+    finally:
+        faults.uninstall()
+    est = ProcessFleet._clock_offset_from_hello(
+        t_send, t_recv, {"t_epoch": t_worker, "pid": 4242})
+    assert est["rtt_s"] >= 2 * delay
+    assert abs(est["offset_s"] - true_skew) <= est["rtt_s"] / 2 + 0.01

@@ -378,6 +378,91 @@ def test_by_source_groups_attribution_by_named_track(obs_sandbox):
 
 
 # ---------------------------------------------------------------------------
+# Merged cross-process timelines
+# ---------------------------------------------------------------------------
+
+
+def _proc_trace(pid, epoch, spans):
+    """A hand-built one-process Chrome trace (merge_traces input):
+    ``spans`` maps span_id -> (name, ts_us, dur_us, extra_args)."""
+    events = [
+        {"name": name, "ph": "X", "ts": ts, "dur": dur,
+         "pid": pid, "tid": 1,
+         "args": {"span_id": sid, "parent_id": 0, **extra}}
+        for sid, (name, ts, dur, extra) in spans.items()
+    ]
+    return {"traceEvents": events, "otherData": {"t_epoch": epoch}}
+
+
+def _merged_fleet_timeline():
+    router = _proc_trace(1000, 100.0, {
+        5: ("proc.request", 0.0, 1000.0, {}),
+    })
+    # the worker's wall clock runs 0.5s AHEAD of the router's and its
+    # tracer booted 0.2s later: epoch 100.7 = 100.0 + 0.5 + 0.2
+    worker = _proc_trace(2000, 100.7, {
+        3: ("proc.worker_request", 100.0, 500.0,
+            {"xparent": 5, "xpid": 1000}),
+    })
+    return report.merge_traces(
+        [router, worker],
+        offsets={2000: {"offset_s": 0.5, "rtt_s": 0.004}},
+        labels={1000: "router", 2000: "worker-0.g1"},
+    )
+
+
+def test_merge_traces_aligns_clocks_and_reparents_across_pids():
+    merged = _merged_fleet_timeline()
+    meta = merged["otherData"]
+    assert meta["n_processes"] == 2
+    assert meta["pids"] == [1000, 2000]
+    assert meta["clock_offsets"] == {
+        "2000": {"offset_s": 0.5, "rtt_s": 0.004}}
+    spans = {
+        (e["pid"], e["name"]): e
+        for e in merged["traceEvents"] if e.get("ph") == "X"
+    }
+    # the worker span lands 0.2s after the router's start once the
+    # 0.5s clock skew is subtracted: 100us own ts + 200000us shift
+    wspan = spans[(2000, "proc.worker_request")]
+    assert wspan["ts"] == pytest.approx(200100.0, abs=0.01)
+    # ids namespaced per process; the cross-process hop re-parents the
+    # worker span under the ROUTER's span 5
+    assert wspan["args"]["span_id"] == report.MERGE_SPAN_NS + 3
+    assert wspan["args"]["parent_id"] == 5
+    assert spans[(1000, "proc.request")]["args"]["span_id"] == 5
+    # the merged timeline is structurally valid Chrome trace JSON
+    assert report.validate_trace_events(merged) == []
+
+
+def test_by_process_groups_merged_timeline_by_pid():
+    rows = report.by_process(_merged_fleet_timeline())
+    by_label = {r["label"]: r for r in rows}
+    assert set(by_label) == {"router", "worker-0.g1"}
+    assert by_label["router"]["pid"] == 1000
+    assert by_label["router"]["spans"] == 1
+    assert by_label["worker-0.g1"]["top"][0]["name"] == (
+        "proc.worker_request")
+
+
+def test_trace_report_by_process_flag(tmp_path, capsys):
+    from scripts.trace_report import main
+
+    path = tmp_path / "BENCH_merged_trace.json"
+    path.write_text(json.dumps(_merged_fleet_timeline()))
+    assert main([str(path), "--by-process", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert {r["label"] for r in out["by_process"]} == {
+        "router", "worker-0.g1"}
+    assert out["clock_offsets"]["2000"]["offset_s"] == 0.5
+    # text mode echoes the rows and the alignment uncertainty
+    assert main([str(path), "--by-process"]) == 0
+    text = capsys.readouterr().out
+    assert "worker-0.g1 (pid 2000)" in text
+    assert "clock offsets" in text and "rtt/2" in text
+
+
+# ---------------------------------------------------------------------------
 # tower_report.py end to end
 # ---------------------------------------------------------------------------
 
@@ -414,6 +499,43 @@ def test_tower_report_renders_and_validates(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "fleet telemetry" in out and "alerts:" in out
     assert "post-mortem: WorkerKilled" in out
+
+
+def test_tower_report_renders_the_procfleet_plane(tmp_path, capsys):
+    """A --procfleet artifact's distributed-observability block: the
+    summary carries it through --json verbatim and the text rendering
+    shows telemetry coverage, per-worker clock offsets (± rtt/2), the
+    exhumed black boxes (flagging a torn index), and the trace merge."""
+    from scripts.tower_report import main
+
+    record = _drill_record()
+    record["procfleet"] = {
+        "n_workers": 2,
+        "worker_deaths": 1,
+        "telemetry": {"frames": 40, "zombie_frames": 1,
+                      "retired_generations": 1, "coverage": 0.91},
+        "clock_offsets": {"1": {"pid": 4242, "generation": 2,
+                                "offset_s": 0.0021, "rtt_s": 0.0004}},
+        "black_box": {"exhumed": [
+            {"rid": 1, "generation": 2, "n_events": 7,
+             "torn_index": True}]},
+        "trace_merge": {"n_processes": 3, "pids": [1, 2, 3],
+                        "cross_process_requests": 5},
+    }
+    path = tmp_path / "BENCH_procfleet.json"
+    path.write_text(json.dumps(record))
+    assert main([str(path), "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    pf = summary["procfleet"]
+    assert pf["telemetry"]["frames"] == 40
+    assert pf["black_box"]["exhumed"][0]["torn_index"] is True
+    assert main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "process fleet: 2 worker(s), 1 death(s)" in out
+    assert "coverage 0.910" in out
+    assert "worker-1 (pid 4242, g2)" in out
+    assert "TORN INDEX" in out
+    assert "trace merge: 3 process(es)" in out
 
 
 def test_tower_report_trips_on_doctored_artifact(tmp_path, capsys):
